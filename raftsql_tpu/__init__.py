@@ -1,0 +1,13 @@
+"""raftsql_tpu — a TPU-native multi-raft replicated-SQL framework.
+
+Brand-new implementation of the capabilities of chzchzchz/raftsql (a SQLite
+database replicated by raft, served over HTTP PUT/GET): N co-located raft
+groups advance in lock-step batched JAX device steps, host code owns WAL
+durability, SQL apply, and transport.  See SURVEY.md for the capability
+contract derived from the reference.
+"""
+
+from raftsql_tpu.config import RaftConfig
+
+__all__ = ["RaftConfig"]
+__version__ = "0.1.0"

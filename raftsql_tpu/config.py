@@ -1,0 +1,77 @@
+"""Configuration for the TPU multi-raft engine.
+
+The reference (chzchzchz/raftsql) hard-codes its consensus timing and sizing
+constants (reference raft.go:154-158, 207; listener.go:56).  Here they are
+named fields of a dataclass, plus the batching knobs that only exist in the
+TPU-native design ({num_groups, peers, log window, entries per append}).
+
+Reference constant parity:
+  - tick_interval_s     <- 100ms ticker           (reference raft.go:207)
+  - election_ticks      <- ElectionTick: 10       (reference raft.go:154)
+  - heartbeat_ticks     <- HeartbeatTick: 1       (reference raft.go:155)
+  - max_entries_per_msg <- MaxSizePerMsg: 1MiB    (reference raft.go:157),
+        recast as an entry-count cap per AppendEntries batch
+  - log_window          <- MaxInflightMsgs: 256   (reference raft.go:158),
+        recast as the on-device log-metadata ring capacity; the host flow
+        controller stops admitting proposals when uncommitted entries would
+        overrun the ring (the reference's in-flight window analog)
+"""
+from __future__ import annotations
+
+import dataclasses
+
+# Role codes for the [groups] role array.
+FOLLOWER = 0
+CANDIDATE = 1
+LEADER = 2
+
+# Message type codes (shared by the vote slot and the append slot).
+MSG_NONE = 0
+MSG_REQ = 1
+MSG_RESP = 2
+
+# voted_for sentinel: no vote cast this term.
+NO_VOTE = -1
+# leader_hint sentinel: leader unknown.
+NO_LEADER = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    """Static shape/timing configuration of a batched multi-raft engine.
+
+    All fields are static w.r.t. jit: changing any of them recompiles the
+    step function.
+    """
+
+    num_groups: int = 1          # G: raft groups advanced per device step
+    num_peers: int = 3           # P: replicas per group (reference: 3, Procfile)
+    log_window: int = 256        # W: on-device log-metadata ring capacity
+    max_entries_per_msg: int = 8  # E: entries per AppendEntries batch
+
+    # Timing, in ticks (one device step == one tick).
+    election_ticks: int = 10     # min randomized election timeout
+    heartbeat_ticks: int = 1     # leader heartbeat period
+
+    # Wall-clock seconds per tick for the host event loop.  The reference
+    # ticks at 100ms; the batched engine defaults much faster because one
+    # device step advances every group at once.
+    tick_interval_s: float = 0.001
+
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.num_peers < 1:
+            raise ValueError("num_peers must be >= 1")
+        if self.num_groups < 1:
+            raise ValueError("num_groups must be >= 1")
+        # The flow-control formula in core/step.py reserves 2*E slots of
+        # headroom; require strictly more so leaders can always admit work.
+        if self.log_window < 4 * self.max_entries_per_msg:
+            raise ValueError("log_window must be >= 4*max_entries_per_msg")
+        if self.election_ticks <= 2 * self.heartbeat_ticks:
+            raise ValueError("election_ticks must be > 2*heartbeat_ticks")
+
+    @property
+    def quorum(self) -> int:
+        return self.num_peers // 2 + 1

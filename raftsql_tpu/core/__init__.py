@@ -1,0 +1,6 @@
+from raftsql_tpu.core.state import (Inbox, Outbox, PeerState, StepInfo,
+                                    empty_inbox, init_peer_state, term_at)
+from raftsql_tpu.core.step import peer_step, peer_step_jit
+
+__all__ = ["Inbox", "Outbox", "PeerState", "StepInfo", "empty_inbox",
+           "init_peer_state", "term_at", "peer_step", "peer_step_jit"]

@@ -1,0 +1,97 @@
+"""Fused whole-cluster stepping: P peers × G groups in one device program.
+
+The reference runs each raft peer as a separate OS process wired by HTTP
+streams (reference raft.go:248-266, Procfile).  On TPU, when a cluster's
+peers are co-located on one chip (the benchmark configuration in
+BASELINE.json), we instead *stack* all P peers' states on the leading axis,
+vmap the peer transition over it, and deliver messages by transposing the
+outbox — src→dst becomes dst→src with a single `swapaxes`, entirely
+on-device.  Consensus for the whole cluster then advances via `lax.scan`
+with zero host round-trips per tick.
+
+The same `peer_step` also serves the distributed deployment (one PeerState
+per host, transport carrying outboxes over DCN) — see runtime/node.py and
+transport/.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
+                                    empty_inbox, init_peer_state)
+from raftsql_tpu.core.step import peer_step
+
+
+def init_cluster_state(cfg: RaftConfig, seed: int | None = None) -> PeerState:
+    """Stacked PeerState with a leading peers axis: every leaf [P, ...]."""
+    states = [init_peer_state(cfg, p, seed) for p in range(cfg.num_peers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def empty_cluster_inbox(cfg: RaftConfig) -> Inbox:
+    boxes = [empty_inbox(cfg) for _ in range(cfg.num_peers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *boxes)
+
+
+def deliver(outbox: Outbox) -> Inbox:
+    """In-device message delivery: [src, G, dst, ...] -> [dst, G, src, ...].
+
+    This transpose is the entire transport for co-located peers — the moral
+    equivalent of the reference's rafthttp streams (raft.go:230, 268-270)
+    collapsing into a data-layout change.  On a multi-chip mesh with the
+    peer axis sharded, the same operation becomes an `all_to_all` over ICI
+    (see parallel/sharded.py).
+    """
+    return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 2), outbox)
+
+
+def cluster_step(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
+                 prop_n: jax.Array
+                 ) -> Tuple[PeerState, Inbox, StepInfo]:
+    """One tick for the whole co-located cluster.
+
+    Args:
+      states: stacked PeerState, leaves [P, ...].
+      inboxes: stacked Inbox, leaves [P, G, P, ...].
+      prop_n: [P, G] i32 — proposals submitted at each peer this tick (only
+        the leader's are accepted; host routes via leader_hint).
+
+    Returns:
+      (new_states, delivered_inboxes_for_next_tick, stacked_infos).
+    """
+    self_ids = jnp.arange(cfg.num_peers, dtype=I32)
+    step = jax.vmap(functools.partial(peer_step, cfg))
+    new_states, outboxes, infos = step(states, inboxes, prop_n, self_ids)
+    return new_states, deliver(outboxes), infos
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def cluster_step_jit(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
+                     prop_n: jax.Array):
+    return cluster_step(cfg, states, inboxes, prop_n)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))
+def cluster_run(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
+                num_ticks: int, prop_n: jax.Array
+                ) -> Tuple[PeerState, Inbox, StepInfo]:
+    """Scan `num_ticks` fused steps on device; prop_n is [T, P, G].
+
+    Returns the final state plus per-tick stacked infos [T, P, G] — the
+    benchmark harness reduces those on device to commit counts so only
+    scalars cross the host boundary.
+    """
+
+    def body(carry, prop_t):
+        st, ib = carry
+        st, ib, info = cluster_step(cfg, st, ib, prop_t)
+        return (st, ib), info
+
+    (states, inboxes), infos = jax.lax.scan(body, (states, inboxes), prop_n,
+                                            length=num_ticks)
+    return states, inboxes, infos

@@ -1,0 +1,185 @@
+"""Struct-of-arrays raft state for one peer across all groups.
+
+The reference keeps one raft group's state inside the vendored etcd/raft
+`raft.Node` object (reference raft.go:48-55).  The TPU-native design replaces
+that object with flat int32 arrays batched over the group axis `G`, so that
+the per-tick transition of *every* group advances in one XLA computation.
+
+All log positions are 1-based: index 0 is the sentinel "before the log"
+position with term 0 (this makes the AppendEntries log-matching check on
+`prev_index == 0` fall out of ordinary array math).  The on-device log keeps
+only entry *terms* in a ring of capacity W — entry payloads (SQL text) live
+host-side in `storage.log`; the device decides ordering/commit, the host owns
+bytes.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import FOLLOWER, NO_LEADER, NO_VOTE, RaftConfig
+
+I32 = jnp.int32
+B = jnp.bool_
+
+
+class PeerState(NamedTuple):
+    """Raft state of ONE peer, batched over G groups.
+
+    Shapes:  [G] unless noted.  `match`/`next_idx`/`votes` are the leader /
+    candidate views over the peer axis, [G, P].  `log_term` is the log
+    metadata ring, [G, W].
+    """
+
+    term: jax.Array          # [G] i32 current term
+    voted_for: jax.Array     # [G] i32 peer voted for this term, NO_VOTE if none
+    role: jax.Array          # [G] i32 FOLLOWER / CANDIDATE / LEADER
+    leader_hint: jax.Array   # [G] i32 last known leader, NO_LEADER if unknown
+
+    commit: jax.Array        # [G] i32 highest committed log index
+    log_len: jax.Array       # [G] i32 highest appended log index
+    log_term: jax.Array      # [G, W] i32 ring: term of entry i at (i-1) % W
+
+    # Timers (in ticks).
+    elapsed: jax.Array       # [G] i32 ticks since last heartbeat/vote grant
+    timeout: jax.Array       # [G] i32 randomized election timeout in ticks
+    hb_elapsed: jax.Array    # [G] i32 leader ticks since last broadcast
+
+    # Candidate view: votes granted to us this term.
+    votes: jax.Array         # [G, P] bool
+
+    # Leader view of each peer (raft Figure 2 volatile leader state).
+    match: jax.Array         # [G, P] i32 highest index known replicated on peer
+    next_idx: jax.Array      # [G, P] i32 next index to send to peer
+
+    rng: jax.Array           # [2]/key PRNG state for election jitter
+    tick: jax.Array          # [] i32 step counter (for PRNG folding)
+
+
+class Inbox(NamedTuple):
+    """Dense per-source message slots delivered to one peer.
+
+    Two slots per (group, source): a *vote* slot (RequestVote req/resp) and
+    an *append* slot (AppendEntries req/resp), distinguished by type codes
+    MSG_NONE / MSG_REQ / MSG_RESP.  This replaces the vendored etcd
+    `raftpb.Message` stream (reference raft.go:268-270) with fixed-width
+    arrays that map directly onto device memory.
+
+    Overwrite-newest slot semantics are safe: raft tolerates message loss,
+    and leaders/candidates re-send every heartbeat tick.
+    """
+
+    # Vote slot [G, P]:
+    v_type: jax.Array        # i32 MSG_NONE / MSG_REQ / MSG_RESP
+    v_term: jax.Array        # i32 sender term
+    v_last_idx: jax.Array    # i32 (req) candidate last log index
+    v_last_term: jax.Array   # i32 (req) candidate last log term
+    v_granted: jax.Array     # bool (resp) vote granted
+
+    # Append slot [G, P] (+ [G, P, E] entry terms):
+    a_type: jax.Array        # i32 MSG_NONE / MSG_REQ / MSG_RESP
+    a_term: jax.Array        # i32 sender term
+    a_prev_idx: jax.Array    # i32 (req) index preceding the batch
+    a_prev_term: jax.Array   # i32 (req) term of prev_idx
+    a_n: jax.Array           # i32 (req) number of entries in batch
+    a_ents: jax.Array        # [G, P, E] i32 (req) terms of batch entries
+    a_commit: jax.Array      # i32 (req) leader commit index
+    a_success: jax.Array     # bool (resp) append accepted
+    a_match: jax.Array       # i32 (resp) match index (or conflict hint)
+
+
+# The outbox has the same schema, indexed [G, dst] instead of [G, src].
+Outbox = Inbox
+
+
+class StepInfo(NamedTuple):
+    """Host-facing observations from one step (all [G] unless noted).
+
+    These drive the host side of the durability contract (reference
+    raft.go:227-235): WAL save of HardState {term, voted_for, commit} and of
+    newly appended entries, payload-log mirroring, and apply-at-commit.
+    """
+
+    commit: jax.Array        # i32 commit index after the step
+    role: jax.Array          # i32 role after the step
+    term: jax.Array          # i32 term after the step
+    voted_for: jax.Array     # i32 vote cast this term (WAL HardState)
+    leader_hint: jax.Array   # i32 current leader if known
+    prop_base: jax.Array     # i32 log index before accepted proposals
+    prop_accepted: jax.Array  # i32 number of proposals appended this step
+    noop: jax.Array          # bool leader appended a no-op at prop_base
+    # Host log-mirroring signals for inbound appends (see step.py):
+    app_from: jax.Array      # i32 src peer whose append we accepted, -1 none
+    app_start: jax.Array     # i32 first log index written from that append
+    app_n: jax.Array         # i32 number of entries written
+    app_conflict: jax.Array  # bool append truncated conflicting suffix
+    new_log_len: jax.Array   # i32 log length after the step
+
+
+def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
+                    seed: int | None = None) -> PeerState:
+    """Fresh boot state (empty log, term 0, follower everywhere).
+
+    Election timeouts start randomized per group/peer so that a cold-booted
+    cluster doesn't produce a split vote storm in lockstep — the moral
+    equivalent of etcd/raft's randomized election timer.
+    """
+    g, p, w = cfg.num_groups, cfg.num_peers, cfg.log_window
+    seed = cfg.seed if seed is None else seed
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), jnp.asarray(self_id))
+    key, sub = jax.random.split(key)
+    timeout = jax.random.randint(
+        sub, (g,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    return PeerState(
+        term=jnp.zeros((g,), I32),
+        voted_for=jnp.full((g,), NO_VOTE, I32),
+        role=jnp.full((g,), FOLLOWER, I32),
+        leader_hint=jnp.full((g,), NO_LEADER, I32),
+        commit=jnp.zeros((g,), I32),
+        log_len=jnp.zeros((g,), I32),
+        log_term=jnp.zeros((g, w), I32),
+        elapsed=jnp.zeros((g,), I32),
+        timeout=timeout,
+        hb_elapsed=jnp.zeros((g,), I32),
+        votes=jnp.zeros((g, p), B),
+        match=jnp.zeros((g, p), I32),
+        next_idx=jnp.ones((g, p), I32),
+        rng=key,
+        tick=jnp.zeros((), I32),
+    )
+
+
+def empty_inbox(cfg: RaftConfig) -> Inbox:
+    g, p, e = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
+    z = jnp.zeros((g, p), I32)
+    zb = jnp.zeros((g, p), B)
+    return Inbox(
+        v_type=z, v_term=z, v_last_idx=z, v_last_term=z, v_granted=zb,
+        a_type=z, a_term=z, a_prev_idx=z, a_prev_term=z, a_n=z,
+        a_ents=jnp.zeros((g, p, e), I32), a_commit=z,
+        a_success=zb, a_match=z,
+    )
+
+
+def term_at(log_term: jax.Array, log_len: jax.Array, idx: jax.Array,
+            window: int) -> jax.Array:
+    """Term of entry `idx` from the ring, with term_at(0) == 0.
+
+    `idx` may be [G] or [G, P]-shaped (log arrays broadcast accordingly).
+    Out-of-range (idx < 1 or idx > log_len) returns 0.  Positions that have
+    slid out of the ring return whatever was overwritten — the host flow
+    controller guarantees the engine never asks for those (see
+    runtime/node.py flow control and config.log_window).
+    """
+    idx = jnp.asarray(idx)
+    squeeze = idx.ndim == log_term.ndim - 1
+    idx2 = idx[..., None] if squeeze else idx
+    got = jnp.take_along_axis(log_term, (idx2 - 1) % window, axis=-1)
+    if squeeze:
+        got = got[..., 0]
+    else:
+        log_len = log_len[..., None]
+    valid = (idx >= 1) & (idx <= log_len)
+    return jnp.where(valid, got, 0)

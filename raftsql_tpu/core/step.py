@@ -1,0 +1,302 @@
+"""The batched raft peer transition — one tick for G groups in one XLA program.
+
+This replaces the vendored etcd/raft state machine the reference drives via
+`Tick`/`Propose`/`Ready`/`Advance` (reference raft.go:204-245): leader
+election, log replication, and quorum commit are expressed as masked dense
+int ops over `[G]` / `[G, P]` / `[G, W]` arrays, so one `peer_step` advances
+every raft group owned by this peer at once.
+
+Semantics follow the raft paper (Figure 2) plus two etcd-isms the reference
+relies on:
+  * randomized election timeouts (per group, per peer);
+  - a no-op entry appended by a freshly elected leader, so old-term entries
+    commit without waiting for client traffic (the reference inherits this
+    from etcd/raft; its publish loop skips the empty entries,
+    reference raft.go:84-87).
+
+Design notes (TPU-first):
+  - No data-dependent control flow: every branch is a `jnp.where` over all
+    groups.  Inactive groups cost lanes, not branches.
+  - Messages are fixed-slot dense arrays (one vote slot + one append slot
+    per (group, src)); overwrite-newest is safe because raft tolerates loss
+    and senders re-send every heartbeat tick.
+  - The log keeps only terms on device, in a ring of capacity W; payload
+    bytes stay host-side.  Flow control (runtime/node.py) keeps the ring
+    from overrunning — the analog of the reference's MaxInflightMsgs window
+    (reference raft.go:158).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_NONE,
+                                MSG_REQ, MSG_RESP, NO_LEADER, NO_VOTE,
+                                RaftConfig)
+from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
+                                    term_at)
+from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
+
+
+def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
+              prop_n: jax.Array, self_id: jax.Array
+              ) -> Tuple[PeerState, Outbox, StepInfo]:
+    """Advance one peer's view of all G groups by one tick.
+
+    Args:
+      cfg: static configuration (shapes, timeouts).
+      state: this peer's PeerState.
+      inbox: dense message slots received since the last tick.
+      prop_n: [G] i32 — number of new local proposals to append if leader
+        (capped at cfg.max_entries_per_msg; host queues the rest).
+      self_id: scalar i32 — this peer's 0-based id (traced, so the same
+        compiled program serves every peer and vmaps over the peer axis).
+
+    Returns:
+      (new_state, outbox, info).  `outbox[g, dst]` is the dense message set
+      to deliver; `info` carries the host-facing signals (commit advance,
+      accepted proposals, accepted append ranges) that drive WAL writes,
+      payload mirroring, and apply.
+    """
+    G, P, W, E = cfg.num_groups, cfg.num_peers, cfg.log_window, \
+        cfg.max_entries_per_msg
+    quorum = cfg.quorum
+    src_ids = jnp.arange(P, dtype=I32)[None, :]                  # [1, P]
+    self_onehot = src_ids == self_id                             # [1, P]
+    grows = jnp.arange(G)[:, None]                               # [G, 1]
+
+    log_term, log_len = state.log_term, state.log_len
+    commit0 = state.commit
+
+    # ---- Phase 1: term catch-up.  Any message with a newer term makes us a
+    # follower of that term (raft §5.1).
+    v_has, a_has = inbox.v_type != MSG_NONE, inbox.a_type != MSG_NONE
+    msg_term = jnp.maximum(
+        jnp.max(jnp.where(v_has, inbox.v_term, 0), axis=-1),
+        jnp.max(jnp.where(a_has, inbox.a_term, 0), axis=-1))      # [G]
+    bumped = msg_term > state.term
+    term = jnp.maximum(state.term, msg_term)
+    role = jnp.where(bumped, FOLLOWER, state.role)
+    voted = jnp.where(bumped, NO_VOTE, state.voted_for)
+    votes = jnp.where(bumped[:, None], False, state.votes)
+    leader_hint = jnp.where(bumped, NO_LEADER, state.leader_hint)
+
+    my_last_term = term_at(log_term, log_len, log_len, W)         # [G]
+
+    # ---- Phase 2: RequestVote requests.  Grant at most one vote per group
+    # per tick (voted_for is single-valued); re-granting to the same
+    # candidate is idempotent.
+    vreq = inbox.v_type == MSG_REQ
+    vreq_cur = vreq & (inbox.v_term == term[:, None])
+    up2date = (inbox.v_last_term > my_last_term[:, None]) | (
+        (inbox.v_last_term == my_last_term[:, None])
+        & (inbox.v_last_idx >= log_len[:, None]))
+    eligible = vreq_cur & up2date & (
+        (voted == NO_VOTE)[:, None] | (voted[:, None] == src_ids))
+    any_grant = eligible.any(-1)
+    grant_to = jnp.argmax(eligible, axis=-1).astype(I32)          # [G]
+    grant = eligible & (src_ids == grant_to[:, None])             # [G, P]
+    voted = jnp.where(any_grant, grant_to, voted)
+
+    # ---- Phase 3: RequestVote responses → candidate tally → leadership.
+    got_vote = (inbox.v_type == MSG_RESP) & (inbox.v_term == term[:, None]) \
+        & inbox.v_granted & (role == CANDIDATE)[:, None]
+    votes = votes | got_vote
+    become_leader = (role == CANDIDATE) & (vote_count(votes) >= quorum)
+    role = jnp.where(become_leader, LEADER, role)
+    leader_hint = jnp.where(become_leader, self_id, leader_hint)
+    next_idx = jnp.where(become_leader[:, None], log_len[:, None] + 1,
+                         state.next_idx)
+    match = jnp.where(become_leader[:, None], 0, state.match)
+
+    # ---- Phase 4: AppendEntries requests.  At most one current-term leader
+    # exists (election safety), so picking one current-term append per group
+    # loses nothing.
+    areq = inbox.a_type == MSG_REQ
+    areq_cur = areq & (inbox.a_term == term[:, None])
+    any_app = areq_cur.any(-1)
+    asrc = jnp.argmax(areq_cur, axis=-1).astype(I32)              # [G]
+    role = jnp.where(any_app & (role == CANDIDATE), FOLLOWER, role)
+    leader_hint = jnp.where(any_app, asrc, leader_hint)
+
+    def pick(x):  # gather the chosen source's message fields → [G, ...]
+        return jnp.take_along_axis(
+            x, asrc.reshape((G,) + (1,) * (x.ndim - 1)), axis=1)[:, 0]
+
+    prev = pick(inbox.a_prev_idx)
+    prev_t = pick(inbox.a_prev_term)
+    a_n = pick(inbox.a_n)
+    a_ents = pick(inbox.a_ents)                                   # [G, E]
+    a_commit = pick(inbox.a_commit)
+
+    prev_ok = (prev == 0) | ((prev <= log_len)
+                             & (term_at(log_term, log_len, prev, W) == prev_t))
+    accept = any_app & prev_ok & (role != LEADER)
+
+    ent_pos = prev[:, None] + 1 + jnp.arange(E, dtype=I32)[None, :]  # [G, E]
+    in_batch = jnp.arange(E, dtype=I32)[None, :] < a_n[:, None]
+    existing = term_at(log_term, log_len, ent_pos, W)
+    conflict = (accept[:, None] & in_batch & (ent_pos <= log_len[:, None])
+                & (existing != a_ents)).any(-1)
+    wmask = accept[:, None] & in_batch
+    wslot = jnp.where(wmask, (ent_pos - 1) % W, W)   # W = out-of-bounds drop
+    log_term = log_term.at[grows, wslot].set(a_ents, mode='drop')
+    app_end = prev + a_n
+    follower_len0 = log_len
+    log_len = jnp.where(
+        accept,
+        jnp.where(conflict, app_end, jnp.maximum(log_len, app_end)),
+        log_len)
+    # Raft Fig. 2: commit = min(leaderCommit, index of last new entry).  The
+    # clamp to app_end (not log_len) matters: positions beyond the accepted
+    # batch are unverified and may diverge from the leader.
+    commit = jnp.where(accept,
+                       jnp.maximum(commit0, jnp.minimum(a_commit, app_end)),
+                       commit0)
+
+    # ---- Phase 5: AppendEntries responses → leader match/next bookkeeping.
+    rs = (inbox.a_type == MSG_RESP) & (inbox.a_term == term[:, None]) \
+        & (role == LEADER)[:, None]
+    rs_ok = rs & inbox.a_success
+    rs_fail = rs & ~inbox.a_success
+    match = jnp.where(rs_ok, jnp.maximum(match, inbox.a_match), match)
+    next_idx = jnp.where(rs_ok, jnp.maximum(next_idx, inbox.a_match + 1),
+                         next_idx)
+    # On reject, back off to the follower's conflict hint (its log length),
+    # the fast-backoff analog of etcd's rejection hints.
+    next_idx = jnp.where(
+        rs_fail,
+        jnp.clip(jnp.minimum(next_idx - 1, inbox.a_match + 1), 1, None),
+        next_idx)
+    next_idx = jnp.maximum(next_idx, match + 1)
+
+    # ---- Phase 6: proposals (+ the new-leader no-op entry).
+    is_leader = role == LEADER
+    # Flow control: never let uncommitted depth overrun the term ring.  The
+    # no-op consumes space too — a flapping leadership under a stalled
+    # commit must not grow the log unboundedly.
+    space = jnp.maximum(W - 2 * E - (log_len - commit), 0)
+    noop_n = (become_leader & (space >= 1)).astype(I32)
+    n_acc = jnp.where(is_leader,
+                      jnp.minimum(jnp.minimum(prop_n, E), space - noop_n), 0)
+    total_app = noop_n + n_acc
+    prop_base = log_len + noop_n
+    app_pos = log_len[:, None] + 1 + jnp.arange(E + 1, dtype=I32)[None, :]
+    pmask = jnp.arange(E + 1, dtype=I32)[None, :] < total_app[:, None]
+    pslot = jnp.where(pmask, (app_pos - 1) % W, W)
+    log_term = log_term.at[grows, pslot].set(
+        jnp.broadcast_to(term[:, None], (G, E + 1)), mode='drop')
+    log_len = log_len + total_app
+    match = jnp.where(is_leader[:, None] & self_onehot, log_len[:, None],
+                      match)
+
+    # ---- Phase 7: leader commit advance — the quorum reduction kernel.
+    commit = quorum_commit_index(
+        match, log_term, log_len, commit, term, is_leader,
+        quorum=quorum, window=W)
+
+    # ---- Phase 8: timers and election start.
+    reset = any_grant | any_app
+    elapsed = jnp.where(is_leader | reset, 0, state.elapsed + 1)
+    fire = (role != LEADER) & (elapsed >= state.timeout)
+    term_resp = term          # term used in responses composed above
+    term = jnp.where(fire, term + 1, term)
+    role = jnp.where(fire, CANDIDATE, role)
+    voted = jnp.where(fire, self_id, voted)
+    votes = jnp.where(fire[:, None], jnp.broadcast_to(self_onehot, (G, P)),
+                      votes)
+    leader_hint = jnp.where(fire, NO_LEADER, leader_hint)
+    elapsed = jnp.where(fire, 0, elapsed)
+    key = jax.random.fold_in(state.rng, state.tick)
+    new_timeout = jax.random.randint(
+        key, (G,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    timeout = jnp.where(fire, new_timeout, state.timeout)
+
+    hb = jnp.where(is_leader, state.hb_elapsed + 1, 0)
+    hb_fire = is_leader & ((hb >= cfg.heartbeat_ticks) | become_leader
+                           | (total_app > 0))
+    hb = jnp.where(hb_fire, 0, hb)
+
+    # ---- Phase 9: compose the outbox.  Write order = priority order:
+    # responses first, then candidate vote-request broadcast, then leader
+    # append broadcast.  A later write overriding a response is safe: every
+    # message carries the sender term, and raft re-sends on the next tick.
+    my_last_term2 = term_at(log_term, log_len, log_len, W)
+
+    is_cand = role == CANDIDATE
+    cand_bcast = is_cand[:, None] & ~self_onehot
+    o_v_type = jnp.where(cand_bcast, MSG_REQ,
+                         jnp.where(vreq, MSG_RESP, MSG_NONE))
+    o_v_term = jnp.where(cand_bcast, term[:, None],
+                         jnp.broadcast_to(term_resp[:, None], (G, P)))
+    o_v_last_idx = jnp.broadcast_to(log_len[:, None], (G, P))
+    o_v_last_term = jnp.broadcast_to(my_last_term2[:, None], (G, P))
+    o_v_granted = grant & ~cand_bcast
+
+    # Append responses (to every append request seen, incl. stale-term ones
+    # so old leaders step down).
+    chosen_mask = areq_cur & (src_ids == asrc[:, None]) & any_app[:, None]
+    succ = chosen_mask & accept[:, None]
+    # Conflict hint on reject: our pre-append log length.
+    hint = jnp.clip(jnp.minimum(prev - 1, follower_len0), 0, None)
+    resp_match = jnp.where(succ, app_end[:, None],
+                           jnp.where(chosen_mask, hint[:, None], 0))
+
+    # Leader append broadcast: to every peer with pending entries, plus
+    # everyone on heartbeat.
+    send_app = is_leader[:, None] & ~self_onehot & (
+        hb_fire[:, None] | (next_idx <= log_len[:, None]))
+    prev_s = jnp.clip(next_idx - 1, 0, log_len[:, None])          # [G, P]
+    n_s = jnp.clip(log_len[:, None] - prev_s, 0, E)
+    prev_t_s = term_at(log_term, log_len, prev_s, W)
+    ent_pos_s = prev_s[:, :, None] + 1 \
+        + jnp.arange(E, dtype=I32)[None, None, :]                 # [G, P, E]
+    ents_s = term_at(log_term, log_len,
+                     ent_pos_s.reshape(G, P * E), W).reshape(G, P, E)
+
+    o_a_type = jnp.where(send_app, MSG_REQ,
+                         jnp.where(areq, MSG_RESP, MSG_NONE))
+    o_a_term = jnp.where(send_app, term[:, None],
+                         jnp.broadcast_to(term_resp[:, None], (G, P)))
+    o_a_prev_idx = jnp.where(send_app, prev_s, 0)
+    o_a_prev_term = jnp.where(send_app, prev_t_s, 0)
+    o_a_n = jnp.where(send_app, n_s, 0)
+    o_a_ents = jnp.where(send_app[:, :, None], ents_s, 0)
+    o_a_commit = jnp.where(send_app, commit[:, None], 0)
+    o_a_success = succ & ~send_app
+    o_a_match = jnp.where(send_app, 0, resp_match)
+
+    outbox = Outbox(
+        v_type=o_v_type, v_term=o_v_term, v_last_idx=o_v_last_idx,
+        v_last_term=o_v_last_term, v_granted=o_v_granted,
+        a_type=o_a_type, a_term=o_a_term, a_prev_idx=o_a_prev_idx,
+        a_prev_term=o_a_prev_term, a_n=o_a_n, a_ents=o_a_ents,
+        a_commit=o_a_commit, a_success=o_a_success, a_match=o_a_match)
+
+    new_state = PeerState(
+        term=term, voted_for=voted, role=role, leader_hint=leader_hint,
+        commit=commit, log_len=log_len, log_term=log_term,
+        elapsed=elapsed, timeout=timeout, hb_elapsed=hb,
+        votes=votes, match=match, next_idx=next_idx,
+        rng=state.rng, tick=state.tick + 1)
+
+    info = StepInfo(
+        commit=commit, role=role, term=term, voted_for=voted,
+        leader_hint=leader_hint,
+        prop_base=prop_base, prop_accepted=n_acc, noop=become_leader,
+        app_from=jnp.where(accept, asrc, -1),
+        app_start=jnp.where(accept, prev + 1, 0),
+        app_n=jnp.where(accept, a_n, 0),
+        app_conflict=conflict,
+        new_log_len=log_len)
+
+    return new_state, outbox, info
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def peer_step_jit(cfg: RaftConfig, state: PeerState, inbox: Inbox,
+                  prop_n: jax.Array, self_id: jax.Array):
+    return peer_step(cfg, state, inbox, prop_n, self_id)

@@ -1,0 +1,45 @@
+"""Quorum / commit-index kernels.
+
+This is the math at the heart of the reference's vendored consensus library
+(etcd/raft's `maybeCommit`, driven from the reference's event loop at
+raft.go:224-235), recast as vectorized reductions over the `[G, P]`
+match-index matrix:
+
+  commit'[g] = the largest index replicated on a quorum of peers, provided
+               the entry at that index carries the leader's current term
+               (raft §5.4.2 — leaders only commit entries of their own term).
+
+The q-th largest of P match indexes is a sort + static gather; XLA lowers
+the tiny fixed-width sort over the peers axis to a comparator network, which
+fuses cleanly into the surrounding step.  See `ops.pallas_quorum` for the
+hand-written Pallas variant used when P is large.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.core import state as _state
+
+
+def quorum_match_index(match: jax.Array, quorum: int) -> jax.Array:
+    """[G, P] match matrix -> [G] q-th largest match index per group."""
+    P = match.shape[-1]
+    sorted_match = jnp.sort(match, axis=-1)          # ascending
+    return sorted_match[..., P - quorum]
+
+
+def quorum_commit_index(match: jax.Array, log_term: jax.Array,
+                        log_len: jax.Array, commit: jax.Array,
+                        term: jax.Array, is_leader: jax.Array,
+                        *, quorum: int, window: int) -> jax.Array:
+    """Advance per-group commit indexes for leader rows; monotone for all."""
+    cand = quorum_match_index(match, quorum)
+    cand_term = _state.term_at(log_term, log_len, cand, window)
+    ok = is_leader & (cand_term == term) & (cand > commit)
+    return jnp.where(ok, cand, commit)
+
+
+def vote_count(votes: jax.Array) -> jax.Array:
+    """[G, P] bool vote matrix -> [G] granted-vote counts."""
+    return votes.sum(axis=-1)

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Tests must run without TPU hardware and must exercise multi-device sharding,
+so we ask XLA for 8 host-platform devices before jax initializes.  This is
+the multi-node-without-a-real-cluster trick of the reference test harness
+(reference raftsql_test.go:16-28, loopback TCP on localhost ports) in its
+TPU-native form.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

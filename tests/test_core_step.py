@@ -1,0 +1,182 @@
+"""Unit tests for the batched raft core: election, replication, commit.
+
+These cover what the reference delegates to the vendored etcd/raft library
+(reference raft.go:30, L0 in SURVEY.md) and therefore never tests itself —
+SURVEY.md §4 lists leader-election tests among the gaps to close.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raftsql_tpu.config import CANDIDATE, FOLLOWER, LEADER, RaftConfig
+from raftsql_tpu.core.cluster import (cluster_run, empty_cluster_inbox,
+                                      init_cluster_state)
+from raftsql_tpu.core.cluster import cluster_step_jit as cluster_step
+from raftsql_tpu.core.state import init_peer_state, term_at
+
+
+def small_cfg(**kw):
+    defaults = dict(num_groups=4, num_peers=3, log_window=32,
+                    max_entries_per_msg=4, election_ticks=10,
+                    heartbeat_ticks=1, seed=42)
+    defaults.update(kw)
+    return RaftConfig(**defaults)
+
+
+def run_ticks(cfg, states, inboxes, n, props=None):
+    if props is None:
+        props = jnp.zeros((n, cfg.num_peers, cfg.num_groups), jnp.int32)
+    return cluster_run(cfg, states, inboxes, n, props)
+
+
+def leaders_per_group(states, cfg):
+    """[G] count of peers believing they lead, in the max term per group."""
+    role = np.asarray(states.role)          # [P, G]
+    term = np.asarray(states.term)
+    max_term = term.max(axis=0)             # [G]
+    is_leader = (role == LEADER) & (term == max_term[None, :])
+    return is_leader.sum(axis=0)
+
+
+class TestElection:
+    def test_single_leader_emerges(self):
+        cfg = small_cfg()
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 100)
+        counts = leaders_per_group(states, cfg)
+        assert (counts == 1).all(), f"leader counts per group: {counts}"
+
+    def test_at_most_one_leader_per_term_always(self):
+        # Election safety invariant checked at every tick.
+        cfg = small_cfg(num_groups=8, seed=3)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        for _ in range(120):
+            props = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+            role = np.asarray(states.role)
+            term = np.asarray(states.term)
+            for g in range(cfg.num_groups):
+                terms_led = term[:, g][role[:, g] == LEADER]
+                assert len(set(terms_led.tolist())) == len(terms_led), (
+                    f"two leaders share a term in group {g}: terms {terms_led}")
+
+    def test_all_groups_agree_on_leader(self):
+        cfg = small_cfg()
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 100)
+        hint = np.asarray(states.leader_hint)   # [P, G]
+        role = np.asarray(states.role)
+        for g in range(cfg.num_groups):
+            leader = int(np.argmax(role[:, g] == LEADER))
+            assert (hint[:, g] == leader).all(), (
+                f"group {g}: hints {hint[:, g]} vs leader {leader}")
+
+    def test_five_peer_groups_elect(self):
+        cfg = small_cfg(num_peers=5, num_groups=8, seed=7)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 150)
+        assert (leaders_per_group(states, cfg) == 1).all()
+
+    def test_single_peer_group_self_elects(self):
+        cfg = small_cfg(num_peers=1, num_groups=2)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 40)
+        assert (np.asarray(states.role) == LEADER).all()
+
+
+class TestReplication:
+    def elect(self, cfg, ticks=100):
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, ticks)
+        assert (leaders_per_group(states, cfg) == 1).all()
+        return states, inboxes
+
+    def propose_at_leader(self, cfg, states, n):
+        """prop_n [P, G] submitting n proposals at each group's leader."""
+        role = np.asarray(states.role)               # [P, G]
+        props = (role == LEADER).astype(np.int32) * n
+        return jnp.asarray(props)
+
+    def test_proposal_commits_everywhere(self):
+        cfg = small_cfg()
+        states, inboxes = self.elect(cfg)
+        base_commit = np.asarray(states.commit).max(axis=0)
+        props = self.propose_at_leader(cfg, states, 2)
+        states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 10)
+        commit = np.asarray(states.commit)            # [P, G]
+        # Every peer of every group commits the new entries.
+        assert (commit >= base_commit[None, :] + 2).all(), commit
+
+    def test_logs_match_on_all_peers(self):
+        cfg = small_cfg()
+        states, inboxes = self.elect(cfg)
+        for _ in range(3):
+            props = self.propose_at_leader(cfg, states, 1)
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+            states, inboxes, _ = run_ticks(cfg, states, inboxes, 5)
+        log_len = np.asarray(states.log_len)
+        assert (log_len == log_len[0:1, :]).all(), log_len
+        # Term sequences agree at every committed position.
+        for g in range(cfg.num_groups):
+            for idx in range(1, int(np.asarray(states.commit)[:, g].min()) + 1):
+                terms = [int(term_at(states.log_term[p], states.log_len[p],
+                                     jnp.asarray([idx] * cfg.num_groups),
+                                     cfg.log_window)[g])
+                         for p in range(cfg.num_peers)]
+                assert len(set(terms)) == 1, (g, idx, terms)
+
+    def test_noop_entry_on_election(self):
+        # A fresh leader appends a no-op so old-term entries can commit
+        # (raft §5.4.2); commit reaches >= 1 with zero client proposals.
+        cfg = small_cfg()
+        states, inboxes = self.elect(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 10)
+        assert (np.asarray(states.commit).max(axis=0) >= 1).all()
+
+    def test_follower_proposals_rejected(self):
+        cfg = small_cfg()
+        states, inboxes = self.elect(cfg)
+        role = np.asarray(states.role)
+        props = jnp.asarray((role != LEADER).astype(np.int32) * 3)
+        before = np.asarray(states.log_len).copy()
+        states, inboxes, info = cluster_step(cfg, states, inboxes, props)
+        acc = np.asarray(info.prop_accepted)          # [P, G]
+        assert (acc[np.asarray(states.role) != LEADER] == 0).all()
+
+
+class TestCommitSafety:
+    def test_commit_monotone(self):
+        cfg = small_cfg(seed=11)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        prev_commit = np.zeros((cfg.num_peers, cfg.num_groups), np.int64)
+        rng = np.random.default_rng(0)
+        for t in range(150):
+            props = jnp.asarray(
+                rng.integers(0, 2, (cfg.num_peers, cfg.num_groups)),
+                dtype=jnp.int32)
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+            commit = np.asarray(states.commit)
+            assert (commit >= prev_commit).all(), f"commit regressed at {t}"
+            prev_commit = commit
+
+    def test_commit_never_exceeds_log(self):
+        cfg = small_cfg(seed=13)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        rng = np.random.default_rng(1)
+        for _ in range(150):
+            props = jnp.asarray(
+                rng.integers(0, 3, (cfg.num_peers, cfg.num_groups)),
+                dtype=jnp.int32)
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+            assert (np.asarray(states.commit)
+                    <= np.asarray(states.log_len)).all()
