@@ -1,0 +1,130 @@
+"""HTTP client API — reference-parity PUT/GET semantics.
+
+Mirrors the reference's httpSQLAPI (reference httpapi.go:26-79):
+  - PUT: body is a write SQL statement; proposed through consensus; the
+    response blocks until the statement is committed AND applied locally.
+    204 No Content on success, 400 + error text on failure
+    (httpapi.go:38-49).
+  - GET: body is a SELECT; served from the local replica, no consensus;
+    rows rendered `|v1|v2|…|\n` (httpapi.go:51-62).
+  - anything else: 405 with `Allow: PUT, GET` (httpapi.go:63-66).
+
+Extensions beyond the reference (multi-group engine):
+  - `X-Raft-Group` header selects the raft group (default 0);
+  - `GET /metrics` returns node counters as JSON (SURVEY.md §5.5).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from raftsql_tpu.runtime.db import RaftDB
+
+log = logging.getLogger("raftsql_tpu.http")
+
+
+def _make_handler(rdb: RaftDB, timeout_s: float):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # quiet, like the reference
+            pass
+
+        def _body(self) -> str:
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n).decode("utf-8")
+
+        def _group(self) -> int:
+            return int(self.headers.get("X-Raft-Group") or 0)
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "text/plain; charset=utf-8") -> None:
+            self.send_response(code)
+            if body or code != 204:
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _err(self, e: Exception) -> None:
+            # dumpErr (reference httpapi.go:30-34): log + 400 + text.
+            msg = str(e)
+            log.info("client error: %s", msg)
+            self._send(400, (msg + "\n").encode("utf-8"))
+
+        def do_PUT(self):
+            try:
+                err = rdb.propose(self._body(),
+                                  self._group()).wait(timeout_s)
+            except Exception as e:
+                self._err(e)
+                return
+            if err is not None:
+                self._err(err)
+            else:
+                self._send(204)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, (json.dumps(rdb.metrics(),
+                                            sort_keys=True) + "\n").encode(),
+                           ctype="application/json")
+                return
+            try:
+                rows = rdb.query(self._body(), self._group())
+            except Exception as e:
+                self._err(e)
+                return
+            self._send(200, rows.encode("utf-8"))
+
+        def _method_not_allowed(self):
+            self.send_response(405)
+            self.send_header("Allow", "PUT")
+            self.send_header("Allow", "GET")
+            body = b"Method not allowed\n"
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_POST = _method_not_allowed
+        do_DELETE = _method_not_allowed
+        do_PATCH = _method_not_allowed
+        do_HEAD = _method_not_allowed
+
+    return Handler
+
+
+class SQLServer:
+    """Stoppable HTTP server (the reference's stoppable listener pattern,
+    listener.go:25-59, applied to the client API)."""
+
+    def __init__(self, port: int, rdb: RaftDB, host: str = "",
+                 timeout_s: float = 30.0):
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         _make_handler(rdb, timeout_s))
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="sql-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def serve_http_sql_api(port: int, rdb: RaftDB) -> None:
+    """Blocking entry point, mirroring ServeHttpSqlAPI
+    (reference httpapi.go:71-79)."""
+    SQLServer(port, rdb).serve_forever()

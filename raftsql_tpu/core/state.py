@@ -151,6 +151,39 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
     )
 
 
+def restore_peer_state(cfg: RaftConfig, self_id: int,
+                       log_terms: dict, hard: dict,
+                       seed: int | None = None) -> PeerState:
+    """Rebuild boot state from a replayed WAL (the reference's RestartNode
+    path, raft.go:122-134, 161-163).
+
+    Args:
+      log_terms: {group: [term of entry 1, term of entry 2, ...]}
+      hard: {group: (term, voted_for, commit)}
+    """
+    import numpy as np
+
+    st = init_peer_state(cfg, self_id, seed)
+    g_, w = cfg.num_groups, cfg.log_window
+    term = np.zeros((g_,), np.int32)
+    voted = np.full((g_,), NO_VOTE, np.int32)
+    commit = np.zeros((g_,), np.int32)
+    log_len = np.zeros((g_,), np.int32)
+    window = np.zeros((g_, w), np.int32)
+    for g in range(g_):
+        t, v, c = hard.get(g, (0, NO_VOTE, 0))
+        term[g], voted[g], commit[g] = t, v, c
+        terms = log_terms.get(g, [])
+        log_len[g] = len(terms)
+        for idx in range(max(1, len(terms) - w + 1), len(terms) + 1):
+            window[g, (idx - 1) % w] = terms[idx - 1]
+        commit[g] = min(commit[g], log_len[g])
+    return st._replace(
+        term=jnp.asarray(term), voted_for=jnp.asarray(voted),
+        commit=jnp.asarray(commit), log_len=jnp.asarray(log_len),
+        log_term=jnp.asarray(window))
+
+
 def empty_inbox(cfg: RaftConfig) -> Inbox:
     g, p, e = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
     z = jnp.zeros((g, p), I32)

@@ -251,6 +251,17 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         hb_fire[:, None] | (next_idx <= log_len[:, None]))
     prev_s = jnp.clip(next_idx - 1, 0, log_len[:, None])          # [G, P]
     n_s = jnp.clip(log_len[:, None] - prev_s, 0, E)
+    # Ring-window guard: every position this message reads (prev_s and the
+    # batch entries) must still be inside the W-entry term ring, or the
+    # gathered terms would be garbage from newer entries occupying the
+    # slots.  A follower lagging more than W entries gets no appends until
+    # host-mediated catch-up (runtime roadmap); it cannot win elections
+    # (log up-to-dateness check), so safety holds even while it stalls.
+    win_floor = log_len[:, None] - W                              # [G, 1]
+    min_acc = jnp.where(prev_s > 0, prev_s,
+                        jnp.where(n_s > 0, 1, 0))
+    in_window = (min_acc == 0) | (min_acc > win_floor)
+    send_app = send_app & in_window
     prev_t_s = term_at(log_term, log_len, prev_s, W)
     ent_pos_s = prev_s[:, :, None] + 1 \
         + jnp.arange(E, dtype=I32)[None, None, :]                 # [G, P, E]
@@ -286,7 +297,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     info = StepInfo(
         commit=commit, role=role, term=term, voted_for=voted,
         leader_hint=leader_hint,
-        prop_base=prop_base, prop_accepted=n_acc, noop=become_leader,
+        prop_base=prop_base, prop_accepted=n_acc, noop=noop_n > 0,
         app_from=jnp.where(accept, asrc, -1),
         app_start=jnp.where(accept, prev + 1, 0),
         app_n=jnp.where(accept, a_n, 0),

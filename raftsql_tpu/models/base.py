@@ -1,0 +1,23 @@
+"""State-machine protocol applied by committed log entries.
+
+The reference hard-wires SQLite as its one state machine (reference
+db.go:13-20); here apply/query are a protocol so multiple state-machine
+families plug into the same replication engine: `sqlite_sm` (reference
+parity) and `kv_sm` (dependency-free, used by benchmarks and chaos tests).
+"""
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+
+class StateMachine(Protocol):
+    def apply(self, command: str) -> Optional[Exception]:
+        """Execute a committed write command; returns the error, if any.
+        Must be deterministic: every replica applies the same sequence."""
+        ...
+
+    def query(self, q: str) -> str:
+        """Read-only local query; raises on invalid queries."""
+        ...
+
+    def close(self) -> None: ...

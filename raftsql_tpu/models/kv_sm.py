@@ -1,0 +1,49 @@
+"""In-memory key-value state machine.
+
+A second state-machine family behind models.base.StateMachine: no SQLite,
+no disk — used by benchmarks (apply cost ≈ 0 isolates consensus
+throughput) and by chaos tests that compare replica states directly.
+
+Commands:  ``SET <key> <value>`` / ``DEL <key>``
+Queries:   ``GET <key>`` → value or empty; ``KEYS`` → sorted keys.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class KVStateMachine:
+    def __init__(self, path: str = ""):
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def apply(self, command: str) -> Optional[Exception]:
+        parts = command.split(" ", 2)
+        with self._lock:
+            try:
+                if parts[0] == "SET" and len(parts) == 3:
+                    self._data[parts[1]] = parts[2]
+                elif parts[0] == "DEL" and len(parts) == 2:
+                    self._data.pop(parts[1], None)
+                else:
+                    return ValueError(f"bad command: {command!r}")
+                return None
+            except Exception as e:     # pragma: no cover - defensive
+                return e
+
+    def query(self, q: str) -> str:
+        parts = q.split(" ", 1)
+        with self._lock:
+            if parts[0] == "GET" and len(parts) == 2:
+                return self._data.get(parts[1], "")
+            if parts[0] == "KEYS":
+                return "\n".join(sorted(self._data))
+        raise ValueError(f"bad query: {q!r}")
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._data)
+
+    def close(self) -> None:
+        pass

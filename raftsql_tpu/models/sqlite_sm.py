@@ -1,0 +1,70 @@
+"""SQLite state machine — reference-parity apply/query semantics.
+
+Mirrors the reference's raftdb SQL handling (reference db.go):
+  - the database file is DELETED on boot and rebuilt entirely from the
+    replicated log — no snapshots yet (db.go:27-29);
+  - writes are applied in commit order under a write lock (db.go:55-57);
+  - reads run against the local replica only, never consulting the
+    leader — stale reads are by design (db.go:128-130);
+  - SELECT rows are rendered `|v1|v2|…|\n` with every column stringified
+    via a byte-slice scan (db.go:137-156): NULL → empty cell, so the
+    `||0|`-style strings the reference tests grep for fall out.
+
+SQLite is C reached through CPython's `sqlite3` binding — the same
+library the reference reaches through cgo (db.go:6), per SURVEY.md §2b V5.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Optional
+
+
+def is_select(query: str) -> bool:
+    """First-token SELECT check, case-insensitive — the reference's naive
+    write/read split (db.go:98-104), preserved deliberately."""
+    tokens = query.strip(" ").split(" ")
+    return len(tokens) > 0 and tokens[0].upper() == "SELECT"
+
+
+def _cell(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class SQLiteStateMachine:
+    def __init__(self, path: str):
+        # Rebuilt from the log on every boot (reference db.go:29).
+        if path != ":memory:" and os.path.exists(path):
+            os.remove(path)
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+
+    def apply(self, command: str) -> Optional[Exception]:
+        with self._lock:
+            try:
+                self._conn.execute(command)
+                self._conn.commit()
+                return None
+            except sqlite3.Error as e:
+                return e
+
+    def query(self, q: str) -> str:
+        with self._lock:
+            cur = self._conn.execute(q)
+            rows = cur.fetchall()
+        out = []
+        for row in rows:
+            out.append("|" + "|".join(_cell(v) for v in row) + "|\n")
+        return "".join(out)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

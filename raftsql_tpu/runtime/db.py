@@ -1,0 +1,153 @@
+"""RaftDB — apply-side state machine driver with ack routing.
+
+Re-design of the reference's `raftdb` (reference db.go:13-167), batched
+over groups:
+
+  - consumes the commit stream and applies each committed command to the
+    group's state machine in commit order (db.go:45-57);
+  - routes per-proposal acks back to waiting clients by *query identity*:
+    a FIFO of callbacks per (group, query); duplicate identical queries
+    queue multiple callbacks and the first commit acks the head — the
+    reference's exact quirk, preserved (db.go:63-76, 112-118, SURVEY.md
+    §2d.3).  Commits originating from replay or other nodes have no
+    callback and are skipped (db.go:64-69);
+  - write/read split: Propose rejects SELECT, Query requires SELECT
+    (db.go:98-110, 123-126);
+  - local non-linearizable reads (db.go:128-130);
+  - on consensus error, every pending ack receives the error and the DB
+    shuts down (db.go:83-95);
+  - the constructor consumes the replay stream synchronously until the
+    `None` sentinel before returning, so the state machine is caught up to
+    the WAL before serving (db.go:40, SURVEY.md §3.1 handshake), then a
+    reader thread consumes live commits (db.go:41).
+
+The optional commit listener mirrors every applied commit (and the replay
+sentinel) to tests — the reference's `commitListenerC` observability hook
+(db.go:19, 48-50, 59-61), which its restart tests depend on.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional, Tuple
+
+from raftsql_tpu.models.base import StateMachine
+from raftsql_tpu.models.sqlite_sm import is_select
+from raftsql_tpu.runtime.node import CLOSED
+from raftsql_tpu.runtime.pipe import RaftPipe
+
+
+class AckFuture:
+    """The reference's buffered `chan error` (db.go:107): one result,
+    delivered once, awaited by one client."""
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self._err: Optional[Exception] = None
+
+    def set(self, err: Optional[Exception]) -> None:
+        self._err = err
+        self._evt.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Exception]:
+        if not self._evt.wait(timeout):
+            raise TimeoutError("proposal not committed in time")
+        return self._err
+
+
+class RaftDB:
+    def __init__(self, sm_factory: Callable[[int], StateMachine],
+                 pipe: RaftPipe, num_groups: int = 1,
+                 listener=None):
+        self.pipe = pipe
+        self.num_groups = num_groups
+        self.listener = listener            # queue-like or None
+        self._sms: Dict[int, StateMachine] = {
+            g: sm_factory(g) for g in range(num_groups)}
+        self._mu = threading.Lock()
+        self._q2cb: Dict[Tuple[int, str], deque] = defaultdict(deque)
+        self._failed: Optional[Exception] = None
+        self._closed = False
+
+        # Synchronous replay consumption (db.go:40): apply until the
+        # sentinel so reads see the replayed state before we return.
+        self._read_commits(replay=True)
+        self._reader = threading.Thread(target=self._read_commits,
+                                        daemon=True, name="raftdb-reader")
+        self._reader.start()
+
+    # ------------------------------------------------------------------
+
+    def _read_commits(self, replay: bool = False) -> None:
+        q = self.pipe.commit_q
+        while True:
+            item = q.get()
+            if item is None:
+                if self.listener is not None:
+                    self.listener.put(None)
+                if replay:
+                    return
+                continue
+            if item is CLOSED:
+                break
+            group, query = item
+            err = self._sms[group].apply(query)
+            if self.listener is not None:
+                self.listener.put((group, query))
+            with self._mu:
+                cbs = self._q2cb.get((group, query))
+                if not cbs:
+                    continue            # replayed or proposed elsewhere
+                cb = cbs.popleft()
+                if not cbs:
+                    del self._q2cb[(group, query)]
+            cb.set(err)
+
+        # Stream closed: clean shutdown or error teardown (db.go:83-95).
+        err = self.pipe.error
+        if err is not None:
+            with self._mu:
+                pending = [cb for cbs in self._q2cb.values() for cb in cbs]
+                self._q2cb.clear()
+                self._failed = err
+            for cb in pending:
+                cb.set(err)
+
+    # ------------------------------------------------------------------
+
+    def propose(self, query: str, group: int = 0) -> AckFuture:
+        """Submit a write; the future resolves after commit + local apply
+        (the reference's blocking-PUT contract, httpapi.go:45-49)."""
+        fut = AckFuture()
+        if is_select(query):
+            fut.set(ValueError("expected non-SELECT"))
+            return fut
+        with self._mu:
+            if self._failed is not None:
+                fut.set(self._failed)
+                return fut
+            self._q2cb[(group, query)].append(fut)
+        self.pipe.propose(group, query.encode("utf-8"))
+        return fut
+
+    def query(self, query: str, group: int = 0) -> str:
+        """Local read — never touches consensus (db.go:123-130)."""
+        if not is_select(query):
+            raise ValueError("expected SELECT")
+        return self._sms[group].query(query)
+
+    def metrics(self) -> dict:
+        return self.pipe.node.metrics.snapshot()
+
+    def close(self) -> Optional[Exception]:
+        with self._mu:
+            if self._closed:
+                return None
+            if self._q2cb:
+                raise RuntimeError("closing db with outstanding callbacks")
+            self._closed = True
+        err = self.pipe.close()
+        self._reader.join(timeout=10)
+        for sm in self._sms.values():
+            sm.close()
+        return err

@@ -1,0 +1,64 @@
+"""Proposal envelopes: exactly-once apply under forward-retry.
+
+The reference forwards proposals to the leader via etcd/raft's MsgProp
+routing and simply loses them if the leader is down — the client's PUT
+hangs forever.  This runtime retries forwarding (runtime/node.py), which
+upgrades delivery to at-least-once; the envelope downgrades apply back to
+exactly-once:
+
+  - every proposal is wrapped with a random 64-bit id before entering the
+    log:  0x01 | u64 id | payload;
+  - at publish time each node tracks the last `window` ids per group and
+    drops re-occurrences.  The dedup decision is a pure function of the
+    committed log prefix, so every replica (and every replay) makes the
+    same decision — replicas stay identical.
+
+Deliberately proposing the same SQL text twice still applies twice (two
+proposals, two ids) — preserving the reference's duplicate-query FIFO
+semantics (reference db.go:70-75).  No-op/conf entries are empty and not
+enveloped (reference skips them at publish, raft.go:84-87).
+"""
+from __future__ import annotations
+
+import secrets
+import struct
+from collections import deque
+from typing import Optional, Tuple
+
+_MAGIC = 0x01
+_HDR = struct.Struct("<BQ")
+
+
+def new_id() -> int:
+    return secrets.randbits(64)
+
+
+def wrap(payload: bytes, pid: Optional[int] = None) -> bytes:
+    return _HDR.pack(_MAGIC, new_id() if pid is None else pid) + payload
+
+
+def unwrap(data: bytes) -> Tuple[Optional[int], bytes]:
+    """Returns (proposal id, payload); id is None for bare entries."""
+    if len(data) >= _HDR.size and data[0] == _MAGIC:
+        _, pid = _HDR.unpack_from(data)
+        return pid, data[_HDR.size:]
+    return None, data
+
+
+class DedupWindow:
+    """Sliding window of recently applied proposal ids for one group."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._fifo: deque = deque()
+        self._set: set = set()
+
+    def seen(self, pid: int) -> bool:
+        """Check-and-insert; True if pid was already applied recently."""
+        if pid in self._set:
+            return True
+        self._set.add(pid)
+        self._fifo.append(pid)
+        if len(self._fifo) > self._cap:
+            self._set.discard(self._fifo.popleft())
+        return False

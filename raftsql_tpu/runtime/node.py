@@ -1,0 +1,422 @@
+"""RaftNode — the host event loop around the batched device step.
+
+This is the TPU-native re-design of the reference's `raftNode`
+(reference raft.go:38-273).  Where the reference's 100ms `serveChannels`
+loop drives one vendored raft group (raft.go:204-245), this loop drives the
+`peer_step` kernel for ALL G groups at once, then performs the host-side
+I/O in the reference's exact durability order (raft.go:227-235):
+
+    device step  →  WAL save (entries + hard state)  →  fsync
+                 →  transport send                   →  publish commits
+
+so entries are durable before they are sent, and sent before they are
+published — invariant §2d.8 of SURVEY.md.
+
+Host responsibilities (the device owns ordering/quorum math only):
+  - staging inbound wire records into dense Inbox arrays;
+  - mirroring entry payload bytes into storage.PayloadLog, both for local
+    proposals (leader) and accepted appends (follower);
+  - attaching payloads to outbound AppendEntries requests;
+  - proposal forwarding to the current leader hint (the reference gets
+    this from etcd/raft's MsgProp routing);
+  - apply-at-commit publishing to the commit queue, with the reference's
+    replay protocol: every replayed entry is published first, then a
+    `None` sentinel marks the channel current (reference raft.go:122-134,
+    consumed by db.go:45-52).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import LEADER, MSG_REQ, MSG_RESP, NO_VOTE, RaftConfig
+from raftsql_tpu.core.state import (Inbox, init_peer_state,
+                                    restore_peer_state)
+from raftsql_tpu.core.step import peer_step_jit
+from raftsql_tpu.runtime.envelope import DedupWindow, unwrap, wrap
+from raftsql_tpu.storage.log import PayloadLog
+from raftsql_tpu.storage.wal import WAL, wal_exists
+from raftsql_tpu.transport.base import (AppendRec, ProposalRec, TickBatch,
+                                        Transport, VoteRec)
+from raftsql_tpu.utils.metrics import NodeMetrics
+
+log = logging.getLogger("raftsql_tpu.node")
+
+# Commit-queue sentinel marking end-of-stream (the reference closes the
+# channel; Python queues need an explicit object).
+CLOSED = object()
+
+
+class RaftNode:
+    """One consensus node: G raft groups, one peer row each.
+
+    node_id is 1-based like the reference (raft.go:148-151); the device
+    peer axis uses node_id - 1.
+    """
+
+    def __init__(self, node_id: int, num_nodes: int, cfg: RaftConfig,
+                 transport: Transport, data_dir: str):
+        if cfg.num_peers != num_nodes:
+            raise ValueError("cfg.num_peers must equal num_nodes")
+        self.cfg = cfg
+        self.node_id = node_id
+        self.self_id = node_id - 1
+        self.num_nodes = num_nodes
+        self.data_dir = data_dir
+        self.transport = transport
+
+        G = cfg.num_groups
+        self.commit_q: "queue.Queue" = queue.Queue()
+        self.error: Optional[Exception] = None
+        self.metrics = NodeMetrics()
+
+        self._stage_lock = threading.Lock()
+        self._stage_votes: Dict[Tuple[int, int], VoteRec] = {}
+        self._stage_apps: Dict[Tuple[int, int], AppendRec] = {}
+
+        self._prop_lock = threading.Lock()
+        self._props: List[deque] = [deque() for _ in range(G)]
+        # Proposals forwarded to a (possibly stale) leader hint, kept as
+        # (payload, deadline_tick): if the payload is not observed
+        # committed by the deadline, it is re-queued and forwarded again.
+        # Without this, a proposal forwarded to a crashed leader is lost
+        # and its client hangs forever (the reference inherits the same
+        # exposure from etcd/raft's MsgProp forwarding; the batched host
+        # plane can do better cheaply).  Commit-observation matches by
+        # payload identity — the same content-FIFO quirk as the ack
+        # router (SURVEY.md §2d.3).
+        self._fwd: List[List[Tuple[bytes, int]]] = [[] for _ in range(G)]
+        self._tick_no = 0
+
+        self.payload_log = PayloadLog(G)
+        self._applied = [0] * G
+        self._dedup = [DedupWindow() for _ in range(G)]
+        self._hard_cache: Dict[int, Tuple[int, int, int]] = {}
+
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._tick_apps: Dict[Tuple[int, int], AppendRec] = {}
+
+        # ---- replay (reference raft.go:122-134 + db.go:27-29 contract).
+        self._had_wal = wal_exists(data_dir)
+        groups = WAL.replay(data_dir)
+        log_terms = {g: [t for (t, _) in gl.entries]
+                     for g, gl in groups.items()}
+        hard = {g: (gl.hard.term, gl.hard.vote, gl.hard.commit)
+                for g, gl in groups.items()}
+        self.state = restore_peer_state(cfg, self.self_id, log_terms, hard)
+        for g, gl in groups.items():
+            self.payload_log.put(g, 1, [d for (_, d) in gl.entries])
+            self._hard_cache[g] = (gl.hard.term, gl.hard.vote,
+                                   gl.hard.commit)
+            # Reference parity: replay publishes every WAL entry, then the
+            # nil sentinel (raft.go:130-132); apply-at-commit only governs
+            # live traffic.  Empty (no-op/conf) entries are skipped
+            # (raft.go:84-87).
+            self._applied[g] = gl.log_len
+        self._replay_groups = groups
+        self.wal = WAL(data_dir)
+        self._self_arr = jnp.asarray(self.self_id, jnp.int32)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        for g, gl in sorted(self._replay_groups.items()):
+            for (term, data) in gl.entries:
+                sql = self._decode_entry(g, data)
+                if sql is not None:
+                    self.commit_q.put((g, sql))
+        self._replay_groups = {}
+        self.commit_q.put(None)         # replay-complete sentinel
+        self.transport.start(self.node_id, self._deliver, self._on_error)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"raft-node-{self.node_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop_evt.is_set():
+            return
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.transport.stop()
+        self.wal.close()
+        self.commit_q.put(CLOSED)
+
+    def _on_error(self, err: Exception) -> None:
+        # Transport failure → teardown, error fans out to pending acks
+        # (reference raft.go:136-142, db.go:83-95).
+        log.error("node %d transport error: %s", self.node_id, err)
+        self.error = err
+        self._stop_evt.set()
+        self.commit_q.put(CLOSED)
+
+    # ------------------------------------------------------------------
+    # client plane
+
+    def propose(self, group: int, payload: bytes) -> None:
+        """Enqueue a proposal; routed to the leader on the next tick.
+
+        The payload is wrapped with a unique envelope id so that
+        forward-retries after leader failure apply exactly once
+        (runtime/envelope.py)."""
+        with self._prop_lock:
+            self._props[group].append(wrap(payload))
+
+    def _decode_entry(self, group: int, data: bytes) -> Optional[str]:
+        """Envelope-aware publish decision: None = skip (empty entry or
+        duplicate of an already-applied forwarded proposal)."""
+        if not data:
+            return None
+        pid, payload = unwrap(data)
+        if pid is not None and self._dedup[group].seen(pid):
+            return None
+        return payload.decode("utf-8")
+
+    def leader_of(self, group: int) -> int:
+        """Last known leader (0-based peer), -1 if unknown."""
+        return int(np.asarray(self.state.leader_hint)[group])
+
+    # ------------------------------------------------------------------
+    # transport plane
+
+    def _deliver(self, src: int, batch: TickBatch) -> None:
+        """Stage inbound records; newest message per (group, src, slot)
+        wins, mirroring the dense Inbox overwrite semantics.
+
+        Records that don't fit this node's configuration (unknown group,
+        oversized entry batch, bad src) are dropped, not fatal: a
+        misconfigured or malicious peer must not tear down this node
+        (cf. the reference trusting rafthttp framing, raft.go:268-270)."""
+        G, E = self.cfg.num_groups, self.cfg.max_entries_per_msg
+        src0 = src - 1
+        if not (0 <= src0 < self.num_nodes) or src0 == self.self_id:
+            log.warning("node %d: dropping batch from bad src %d",
+                        self.node_id, src)
+            return
+        with self._stage_lock:
+            for v in batch.votes:
+                if 0 <= v.group < G:
+                    self._stage_votes[(v.group, src0)] = v
+            for a in batch.appends:
+                if 0 <= a.group < G and a.n <= E \
+                        and len(a.payloads) in (0, a.n):
+                    self._stage_apps[(a.group, src0)] = a
+        if batch.proposals:
+            with self._prop_lock:
+                for pr in batch.proposals:
+                    if 0 <= pr.group < G:
+                        self._props[pr.group].append(pr.payload)
+
+    # ------------------------------------------------------------------
+    # the event loop
+
+    def _run(self) -> None:
+        interval = self.cfg.tick_interval_s
+        while not self._stop_evt.is_set():
+            t0 = time.monotonic()
+            try:
+                self.tick()
+            except Exception as e:       # pragma: no cover - defensive
+                log.exception("node %d tick failed", self.node_id)
+                self._on_error(e)
+                return
+            dt = time.monotonic() - t0
+            if dt < interval:
+                time.sleep(interval - dt)
+
+    def tick(self) -> None:
+        """One full consensus tick: stage → step → WAL → send → publish."""
+        cfg = self.cfg
+        G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
+
+        inbox, tick_apps = self._build_inbox()
+        self._tick_apps = tick_apps
+
+        with self._prop_lock:
+            prop_n = np.fromiter(
+                (min(len(q), E) for q in self._props), np.int32, G)
+
+        state, outbox, info = peer_step_jit(
+            cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr)
+        self.state = state
+        outbox, info = jax.device_get((outbox, info))
+
+        self._wal_phase(info)           # durable …
+        self._send_phase(outbox, info)  # … before sent …
+        self._publish_phase(info)       # … before published.
+        self._tick_no += 1
+        self.metrics.ticks += 1
+
+    # -- tick phases -----------------------------------------------------
+
+    def _build_inbox(self):
+        cfg = self.cfg
+        G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
+        z = lambda: np.zeros((G, P), np.int32)
+        zb = lambda: np.zeros((G, P), bool)
+        v_type, v_term, v_li, v_lt = z(), z(), z(), z()
+        v_gr = zb()
+        a_type, a_term, a_pi, a_pt, a_n, a_cm, a_ma = (
+            z(), z(), z(), z(), z(), z(), z())
+        a_su = zb()
+        a_ents = np.zeros((G, P, E), np.int32)
+        with self._stage_lock:
+            votes, apps = self._stage_votes, self._stage_apps
+            self._stage_votes, self._stage_apps = {}, {}
+        for (g, s), v in votes.items():
+            v_type[g, s], v_term[g, s] = v.type, v.term
+            v_li[g, s], v_lt[g, s] = v.last_idx, v.last_term
+            v_gr[g, s] = v.granted
+        for (g, s), a in apps.items():
+            a_type[g, s], a_term[g, s] = a.type, a.term
+            a_pi[g, s], a_pt[g, s] = a.prev_idx, a.prev_term
+            a_n[g, s], a_cm[g, s] = a.n, a.commit
+            a_su[g, s], a_ma[g, s] = a.success, a.match
+            a_ents[g, s, :a.n] = a.ent_terms[:E]
+        inbox = Inbox(
+            v_type=jnp.asarray(v_type), v_term=jnp.asarray(v_term),
+            v_last_idx=jnp.asarray(v_li), v_last_term=jnp.asarray(v_lt),
+            v_granted=jnp.asarray(v_gr),
+            a_type=jnp.asarray(a_type), a_term=jnp.asarray(a_term),
+            a_prev_idx=jnp.asarray(a_pi), a_prev_term=jnp.asarray(a_pt),
+            a_n=jnp.asarray(a_n), a_ents=jnp.asarray(a_ents),
+            a_commit=jnp.asarray(a_cm), a_success=jnp.asarray(a_su),
+            a_match=jnp.asarray(a_ma))
+        return inbox, apps
+
+    def _wal_phase(self, info) -> None:
+        """Persist this tick's appends + hard-state changes, one fsync."""
+        G = self.cfg.num_groups
+        term = info.term
+        for g in range(G):
+            n_acc = int(info.prop_accepted[g])
+            if info.noop[g] or n_acc:
+                base = int(info.prop_base[g])
+                if info.noop[g]:
+                    self.wal.append_entry(g, base, int(term[g]), b"")
+                    self.payload_log.put(g, base, [b""])
+                if n_acc:
+                    with self._prop_lock:
+                        batch = [self._props[g].popleft()
+                                 for _ in range(n_acc)]
+                    for i, data in enumerate(batch):
+                        self.wal.append_entry(g, base + 1 + i,
+                                              int(term[g]), data)
+                    self.payload_log.put(g, base + 1, batch)
+                self.metrics.proposals += n_acc
+            src = int(info.app_from[g])
+            if src >= 0:
+                rec = self._tick_apps.get((g, src))
+                if rec is None:      # staged slot raced away; next resend
+                    continue         # re-delivers — raft tolerates loss
+                start = int(info.app_start[g])
+                new_len = int(info.new_log_len[g])
+                for i in range(int(info.app_n[g])):
+                    self.wal.append_entry(g, start + i, rec.ent_terms[i],
+                                          rec.payloads[i])
+                self.payload_log.put(g, start, rec.payloads,
+                                     new_len=new_len)
+                if info.app_conflict[g] and self._applied[g] >= start:
+                    # Only possible for replay-published uncommitted
+                    # entries (the reference applies at append and shares
+                    # this hazard — SURVEY.md §3.2 quirk).
+                    log.warning("node %d g%d: conflict truncation below "
+                                "applied=%d; state machine may have seen "
+                                "an uncommitted entry", self.node_id, g,
+                                self._applied[g])
+                    self._applied[g] = min(self._applied[g], start - 1)
+            hs = (int(term[g]), int(info.voted_for[g]), int(info.commit[g]))
+            if self._hard_cache.get(g) != hs:
+                self.wal.set_hardstate(g, *hs)
+                self._hard_cache[g] = hs
+        self.wal.sync()
+
+    def _send_phase(self, outbox, info) -> None:
+        cfg = self.cfg
+        batches: Dict[int, TickBatch] = {}
+
+        def batch_for(dst0: int) -> TickBatch:
+            return batches.setdefault(dst0, TickBatch())
+
+        vg, vd = np.nonzero(outbox.v_type)
+        for g, d in zip(vg.tolist(), vd.tolist()):
+            batch_for(d).votes.append(VoteRec(
+                group=g, type=int(outbox.v_type[g, d]),
+                term=int(outbox.v_term[g, d]),
+                last_idx=int(outbox.v_last_idx[g, d]),
+                last_term=int(outbox.v_last_term[g, d]),
+                granted=bool(outbox.v_granted[g, d])))
+        ag, ad = np.nonzero(outbox.a_type)
+        for g, d in zip(ag.tolist(), ad.tolist()):
+            mtype = int(outbox.a_type[g, d])
+            n = int(outbox.a_n[g, d])
+            prev = int(outbox.a_prev_idx[g, d])
+            payloads = (self.payload_log.slice(g, prev + 1, n)
+                        if mtype == MSG_REQ else [])
+            batch_for(d).appends.append(AppendRec(
+                group=g, type=mtype, term=int(outbox.a_term[g, d]),
+                prev_idx=prev, prev_term=int(outbox.a_prev_term[g, d]),
+                ent_terms=[int(t) for t in outbox.a_ents[g, d, :n]],
+                payloads=payloads, commit=int(outbox.a_commit[g, d]),
+                success=bool(outbox.a_success[g, d]),
+                match=int(outbox.a_match[g, d])))
+
+        # Proposal forwarding: anything still queued while we are not the
+        # leader goes to the leader hint, and is tracked for retry until
+        # its commit is observed (see _fwd above).
+        role = info.role
+        hint = info.leader_hint
+        deadline = self._tick_no + 4 * cfg.election_ticks
+        with self._prop_lock:
+            for g in range(cfg.num_groups):
+                expired = [p for (p, d) in self._fwd[g]
+                           if d <= self._tick_no]
+                if expired:
+                    self._fwd[g] = [(p, d) for (p, d) in self._fwd[g]
+                                    if d > self._tick_no]
+                    self._props[g].extendleft(reversed(expired))
+                h = int(hint[g])
+                if role[g] != LEADER and h >= 0 and h != self.self_id \
+                        and self._props[g]:
+                    fwd = list(self._props[g])
+                    self._props[g].clear()
+                    for p in fwd:
+                        batch_for(h).proposals.append(
+                            ProposalRec(group=g, payload=p))
+                        self._fwd[g].append((p, deadline))
+
+        for dst0, batch in batches.items():
+            self.transport.send(dst0 + 1, batch)
+            self.metrics.msgs_sent += (len(batch.votes)
+                                       + len(batch.appends)
+                                       + len(batch.proposals))
+
+    def _publish_phase(self, info) -> None:
+        for g in range(self.cfg.num_groups):
+            c = int(info.commit[g])
+            while self._applied[g] < c:
+                idx = self._applied[g] + 1
+                data = self.payload_log.get(g, idx)
+                if data and self._fwd[g]:
+                    # Forwarded proposal observed committed: retire it
+                    # (exact match — envelope ids are unique).
+                    for k, (p, _) in enumerate(self._fwd[g]):
+                        if p == data:
+                            del self._fwd[g][k]
+                            break
+                sql = self._decode_entry(g, data)
+                if sql is not None:
+                    self.commit_q.put((g, sql))
+                self._applied[g] += 1
+                self.metrics.commits += 1

@@ -1,0 +1,42 @@
+"""RaftPipe — the propose/commit/error facade (QuorumBackend seam).
+
+The reference's 17-line `raftPipe` (reference raftpipe.go:3-17) bundles
+{ProposeC, CommitC, ErrorC}: everything above consensus sees "strings in,
+totally ordered strings out".  SURVEY.md §1 marks this as THE seam where
+the TPU backend plugs in; here it is the same triple, batched with group
+ids, backed by a RaftNode.
+
+close() mirrors the reference contract (raftpipe.go:14-17): stop accepting
+proposals, shut the node down, and return the terminal error (None on a
+clean shutdown).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from raftsql_tpu.runtime.node import RaftNode
+
+
+class RaftPipe:
+    def __init__(self, node: RaftNode):
+        self.node = node
+        self.commit_q = node.commit_q     # (group, sql) | None | CLOSED
+
+    @classmethod
+    def create(cls, node_id: int, num_nodes: int, cfg, transport,
+               data_dir: str) -> "RaftPipe":
+        node = RaftNode(node_id, num_nodes, cfg, transport, data_dir)
+        pipe = cls(node)
+        node.start()
+        return pipe
+
+    def propose(self, group: int, payload: bytes) -> None:
+        self.node.propose(group, payload)
+
+    @property
+    def error(self) -> Optional[Exception]:
+        return self.node.error
+
+    def close(self) -> Optional[Exception]:
+        self.node.stop()
+        return self.node.error

@@ -1,0 +1,70 @@
+"""CLI entry point — the reference's server/main.go composition.
+
+Wires proposeC→raftPipe→raftdb→HTTP exactly as the reference does
+(reference server/main.go:24-38), with the TPU-native pieces underneath:
+
+    python -m raftsql_tpu.server.main \
+        --cluster http://127.0.0.1:12379,http://127.0.0.1:22379,... \
+        --id 1 --port 12380
+
+Flag parity: --cluster / --id / --port match the reference (main.go:25-27);
+the DB file is `raftsql-<id>.db` (main.go:37) and the WAL dir
+`raftsql-<id>` (raft.go:69).  New knobs expose the batched engine:
+--groups (raft groups served by this cluster), --tick (seconds per device
+step; the reference hard-codes 100ms, raft.go:207).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+from raftsql_tpu.api.http import serve_http_sql_api
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+from raftsql_tpu.runtime.db import RaftDB
+from raftsql_tpu.runtime.pipe import RaftPipe
+from raftsql_tpu.transport.tcp import TcpTransport
+
+
+def build_node(cluster: str, node_id: int, groups: int = 1,
+               tick: float = 0.01, election_ticks: int = 10,
+               data_prefix: str = "raftsql") -> RaftDB:
+    peers = cluster.split(",")
+    cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
+                     tick_interval_s=tick, election_ticks=election_ticks)
+    transport = TcpTransport(peers, node_id - 1)
+    pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
+                           data_dir=f"{data_prefix}-{node_id}")
+
+    def sm_factory(g: int) -> SQLiteStateMachine:
+        path = (f"{data_prefix}-{node_id}.db" if g == 0
+                else f"{data_prefix}-{node_id}-g{g}.db")
+        return SQLiteStateMachine(path)
+
+    return RaftDB(sm_factory, pipe, num_groups=groups)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="TPU-native replicated SQL")
+    ap.add_argument("--cluster", default="http://127.0.0.1:9021",
+                    help="comma separated cluster peers")
+    ap.add_argument("--id", type=int, default=1, help="node ID (1-based)")
+    ap.add_argument("--port", type=int, default=9121,
+                    help="sql server port")
+    ap.add_argument("--groups", type=int, default=1,
+                    help="number of raft groups")
+    ap.add_argument("--tick", type=float, default=0.01,
+                    help="seconds per consensus tick")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    rdb = build_node(args.cluster, args.id, groups=args.groups,
+                     tick=args.tick)
+    serve_http_sql_api(args.port, rdb)
+
+
+if __name__ == "__main__":
+    main()

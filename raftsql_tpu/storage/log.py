@@ -1,0 +1,55 @@
+"""Host-side payload log: entry bytes per (group, index).
+
+The device log (core/state.py) stores only entry *terms*; the bytes of
+each proposal (SQL text) live here, mirroring device log positions 1:1.
+This splits the reference's `raft.MemoryStorage` (reference raft.go:129,
+229) into its two real roles: ordering metadata (device) and bytes (host).
+
+Like MemoryStorage, growth is unbounded and never compacted — a documented
+limitation shared with the reference; snapshots are the eventual fix for
+both (reference db.go:27-29 declares the same).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class PayloadLog:
+    """1-based, truncate-on-conflict byte log for G groups."""
+
+    def __init__(self, num_groups: int):
+        self._logs: List[List[bytes]] = [[] for _ in range(num_groups)]
+
+    def length(self, group: int) -> int:
+        return len(self._logs[group])
+
+    def get(self, group: int, index: int) -> bytes:
+        return self._logs[group][index - 1]
+
+    def slice(self, group: int, start: int, n: int) -> List[bytes]:
+        """Entries [start, start+n), 1-based."""
+        return self._logs[group][start - 1: start - 1 + n]
+
+    def put(self, group: int, start: int, payloads: List[bytes],
+            new_len: Optional[int] = None) -> None:
+        """Write payloads at [start, start+len), extending/overwriting; then
+        truncate to new_len if given (the conflict-truncation mirror of the
+        device-side append in core/step.py Phase 4)."""
+        log = self._logs[group]
+        for i, data in enumerate(payloads):
+            pos = start - 1 + i
+            if pos < len(log):
+                log[pos] = data
+            elif pos == len(log):
+                log.append(data)
+            else:
+                raise ValueError(
+                    f"payload gap: group {group} idx {pos + 1} > "
+                    f"len {len(log)}")
+        if new_len is not None and new_len < len(log):
+            del log[new_len:]
+
+    def append(self, group: int, payloads: List[bytes]) -> int:
+        """Append at the tail; returns the new length."""
+        self._logs[group].extend(payloads)
+        return len(self._logs[group])

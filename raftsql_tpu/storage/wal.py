@@ -1,0 +1,139 @@
+"""Durable write-ahead log, multi-group, host-side.
+
+Replaces the reference's vendored `etcd/wal` (reference raft.go:33-34,
+99-134): an append-only record log that persists raft entries and hard
+state *before* peer messages are sent or commits published (the durability
+ordering invariant, reference raft.go:227-235), and is fully replayed on
+restart (reference raft.go:122-134).
+
+Differences from etcd/wal, by design:
+  - One WAL serves ALL raft groups of a node; records carry a group id, so
+    a single fsync batches the tick's appends across every group — the
+    group-commit analog of batching consensus math on device.
+  - Records are fixed-layout little-endian structs (struct-of-arrays
+    friendly, shared with the C++ fast path in native/wal.cc, loaded via
+    storage.native_wal when built).
+
+Record layout:  u32 crc32(body) | u32 body_len | body
+  body := u8 type | fields
+  type 1 ENTRY:     u32 group | u64 index | u64 term | bytes data
+  type 2 HARDSTATE: u32 group | u64 term | i64 vote | u64 commit
+
+Replay semantics match raft log truncation: a later ENTRY record at an
+index <= the current length truncates the log to index-1 first (conflict
+overwrite, see core/step.py Phase 4); the last HARDSTATE per group wins.
+A torn tail (bad CRC / short read) is dropped, like etcd's repair path.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_HDR = struct.Struct("<II")          # crc, body_len
+_ENTRY = struct.Struct("<BIQQ")      # type, group, index, term
+_HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
+
+REC_ENTRY = 1
+REC_HARDSTATE = 2
+
+WAL_FILE = "wal-0.log"
+
+
+@dataclass
+class HardState:
+    term: int = 0
+    vote: int = -1
+    commit: int = 0
+
+
+@dataclass
+class GroupLog:
+    """Replayed per-group state: 1-based entries plus last hard state."""
+    hard: HardState = field(default_factory=HardState)
+    entries: List[Tuple[int, bytes]] = field(default_factory=list)  # (term, data)
+
+    @property
+    def log_len(self) -> int:
+        return len(self.entries)
+
+
+def wal_exists(dirname: str) -> bool:
+    return os.path.isfile(os.path.join(dirname, WAL_FILE))
+
+
+class WAL:
+    """Append-only multi-group WAL with batched fsync.
+
+    Usage per tick (the reference's Ready handling, raft.go:227-235):
+        wal.begin()
+        wal.append_entry(...); wal.set_hardstate(...)
+        wal.sync()              # durable point — only now send/publish
+    """
+
+    def __init__(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        self.path = os.path.join(dirname, WAL_FILE)
+        self._f = open(self.path, "ab")
+        self._pending = False
+
+    # -- write path ------------------------------------------------------
+
+    def _write(self, body: bytes) -> None:
+        self._f.write(_HDR.pack(zlib.crc32(body), len(body)))
+        self._f.write(body)
+        self._pending = True
+
+    def append_entry(self, group: int, index: int, term: int,
+                     data: bytes) -> None:
+        self._write(_ENTRY.pack(REC_ENTRY, group, index, term) + data)
+
+    def set_hardstate(self, group: int, term: int, vote: int,
+                      commit: int) -> None:
+        self._write(_HARD.pack(REC_HARDSTATE, group, term, vote, commit))
+
+    def sync(self) -> None:
+        if self._pending:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._pending = False
+
+    def close(self) -> None:
+        self.sync()
+        self._f.close()
+
+    # -- replay ----------------------------------------------------------
+
+    @staticmethod
+    def replay(dirname: str) -> Dict[int, GroupLog]:
+        """Read the WAL back into per-group logs; tolerate a torn tail."""
+        groups: Dict[int, GroupLog] = {}
+        path = os.path.join(dirname, WAL_FILE)
+        if not os.path.isfile(path):
+            return groups
+        with open(path, "rb") as f:
+            blob = f.read()
+        off = 0
+        while off + _HDR.size <= len(blob):
+            crc, blen = _HDR.unpack_from(blob, off)
+            body = blob[off + _HDR.size: off + _HDR.size + blen]
+            if len(body) != blen or zlib.crc32(body) != crc:
+                break               # torn tail — drop the rest
+            off += _HDR.size + blen
+            rtype = body[0]
+            if rtype == REC_ENTRY:
+                _, group, index, term = _ENTRY.unpack_from(body)
+                data = body[_ENTRY.size:]
+                gl = groups.setdefault(group, GroupLog())
+                if index <= len(gl.entries):
+                    del gl.entries[index - 1:]      # conflict truncation
+                if index == len(gl.entries) + 1:
+                    gl.entries.append((term, data))
+                # else: a gap would mean WAL corruption; skip the record.
+            elif rtype == REC_HARDSTATE:
+                _, group, term, vote, commit = _HARD.unpack_from(body)
+                gl = groups.setdefault(group, GroupLog())
+                gl.hard = HardState(term=term, vote=vote, commit=commit)
+        return groups

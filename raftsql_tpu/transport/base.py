@@ -1,0 +1,90 @@
+"""Transport protocol + wire records for the peer message plane.
+
+This is the TPU-native replacement for the reference's vendored
+`etcd/rafthttp` transport (reference raft.go:170-184, 230, 248-273):
+per-tick *batches* of fixed-layout records move between nodes, instead of a
+stream of protobuf messages.  Three implementations share this interface:
+
+  - transport.loopback — in-process, for tests and single-host clusters
+    (the reference test harness's localhost trick, raftsql_test.go:19);
+  - transport.tcp      — DCN path between hosts, length-prefixed frames
+    over persistent sockets;
+  - the fused on-device path (core/cluster.deliver) needs no transport at
+    all — delivery is an array transpose (and an ICI all_to_all when the
+    peer axis is sharded, parallel/sharded.py).
+
+Wire records mirror the dense Inbox slots (core/state.py) one-to-one, so
+staging inbound records into device arrays is a plain scatter.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Protocol, Sequence
+
+
+@dataclass
+class VoteRec:
+    group: int
+    type: int           # MSG_REQ / MSG_RESP
+    term: int
+    last_idx: int = 0   # request fields
+    last_term: int = 0
+    granted: bool = False  # response field
+
+
+@dataclass
+class AppendRec:
+    group: int
+    type: int           # MSG_REQ / MSG_RESP
+    term: int
+    prev_idx: int = 0
+    prev_term: int = 0
+    ent_terms: List[int] = field(default_factory=list)
+    payloads: List[bytes] = field(default_factory=list)   # REQ only
+    commit: int = 0
+    success: bool = False   # response fields
+    match: int = 0
+
+    @property
+    def n(self) -> int:
+        return len(self.ent_terms)
+
+
+@dataclass
+class ProposalRec:
+    """Host-level proposal forward to the (hinted) leader.
+
+    The reference gets leader forwarding for free from etcd/raft's MsgProp
+    routing; here it is an explicit host-plane record.
+    """
+    group: int
+    payload: bytes
+
+
+@dataclass
+class TickBatch:
+    """Everything one node sends another for one tick."""
+    votes: List[VoteRec] = field(default_factory=list)
+    appends: List[AppendRec] = field(default_factory=list)
+    proposals: List[ProposalRec] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (self.votes or self.appends or self.proposals)
+
+
+class Transport(Protocol):
+    """Peer message plane for one node.
+
+    `send` must not block the tick loop on slow peers (drop or buffer);
+    raft tolerates loss.  Fatal transport errors surface via the error
+    callback, which triggers node teardown (reference raft.go:136-142,
+    237-239).
+    """
+
+    def start(self, node_id: int,
+              deliver: Callable[[int, TickBatch], None],
+              on_error: Callable[[Exception], None]) -> None: ...
+
+    def send(self, dst: int, batch: TickBatch) -> None: ...
+
+    def stop(self) -> None: ...

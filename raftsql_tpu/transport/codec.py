@@ -1,0 +1,96 @@
+"""Binary codec for TickBatch — flat, fixed-width, little-endian.
+
+SURVEY.md §2b V2 calls for a flat array-friendly encoding at the wire
+boundary instead of protobuf: every record is a fixed-layout struct with
+byte payloads appended, so encode/decode is a linear scan with no schema
+machinery.  The same layout is shared with the C++ runtime shim.
+
+Frame := u32 n_votes  | VoteRec*
+         u32 n_appends| AppendRec*
+         u32 n_props  | ProposalRec*
+VoteRec     := u32 group | u8 type | q term | q last_idx | q last_term | u8 granted
+AppendRec   := u32 group | u8 type | q term | q prev_idx | q prev_term
+             | q commit | u8 success | q match | u16 n
+             | q ent_term * n | (u32 len | bytes) * n_payloads(=n for REQ, 0 resp)
+ProposalRec := u32 group | u32 len | bytes
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from raftsql_tpu.config import MSG_REQ
+from raftsql_tpu.transport.base import (AppendRec, ProposalRec, TickBatch,
+                                        VoteRec)
+
+_U32 = struct.Struct("<I")
+_VOTE = struct.Struct("<IBqqqB")
+_APP = struct.Struct("<IBqqqqBqH")
+_PLEN = struct.Struct("<I")
+
+
+def encode_batch(batch: TickBatch) -> bytes:
+    out = [_U32.pack(len(batch.votes))]
+    for v in batch.votes:
+        out.append(_VOTE.pack(v.group, v.type, v.term, v.last_idx,
+                              v.last_term, int(v.granted)))
+    out.append(_U32.pack(len(batch.appends)))
+    for a in batch.appends:
+        out.append(_APP.pack(a.group, a.type, a.term, a.prev_idx,
+                             a.prev_term, a.commit, int(a.success), a.match,
+                             len(a.ent_terms)))
+        out.append(struct.pack(f"<{len(a.ent_terms)}q", *a.ent_terms))
+        if a.type == MSG_REQ:
+            assert len(a.payloads) == len(a.ent_terms), \
+                "append REQ must carry one payload per entry"
+            for p in a.payloads:
+                out.append(_PLEN.pack(len(p)))
+                out.append(p)
+    out.append(_U32.pack(len(batch.proposals)))
+    for pr in batch.proposals:
+        out.append(_U32.pack(pr.group))
+        out.append(_PLEN.pack(len(pr.payload)))
+        out.append(pr.payload)
+    return b"".join(out)
+
+
+def decode_batch(blob: bytes) -> TickBatch:
+    off = 0
+
+    def take(fmt: struct.Struct) -> Tuple:
+        nonlocal off
+        vals = fmt.unpack_from(blob, off)
+        off += fmt.size
+        return vals
+
+    batch = TickBatch()
+    (nv,) = take(_U32)
+    for _ in range(nv):
+        g, t, term, li, lt, gr = take(_VOTE)
+        batch.votes.append(VoteRec(group=g, type=t, term=term, last_idx=li,
+                                   last_term=lt, granted=bool(gr)))
+    (na,) = take(_U32)
+    for _ in range(na):
+        g, t, term, pi, pt, cm, su, ma, n = take(_APP)
+        terms = list(struct.unpack_from(f"<{n}q", blob, off))
+        off += 8 * n
+        payloads: List[bytes] = []
+        if t == MSG_REQ:
+            for _ in range(n):
+                (plen,) = _PLEN.unpack_from(blob, off)
+                off += _PLEN.size
+                payloads.append(blob[off:off + plen])
+                off += plen
+        batch.appends.append(AppendRec(
+            group=g, type=t, term=term, prev_idx=pi, prev_term=pt,
+            ent_terms=terms, payloads=payloads, commit=cm,
+            success=bool(su), match=ma))
+    (np_,) = take(_U32)
+    for _ in range(np_):
+        (g,) = take(_U32)
+        (plen,) = _PLEN.unpack_from(blob, off)
+        off += _PLEN.size
+        batch.proposals.append(ProposalRec(group=g,
+                                           payload=blob[off:off + plen]))
+        off += plen
+    return batch

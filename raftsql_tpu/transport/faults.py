@@ -1,0 +1,58 @@
+"""Fault injection for the batched message plane.
+
+The reference's only fault story is test-driven node stop/restart
+(reference raftsql_test.go:47-52, 117-170) — SURVEY.md §5.3 calls for
+injectable message drop/delay in the batched transport.  Because messages
+here are dense arrays, faults are *masks*: dropping a message zeroes its
+type code; partitioning a peer zeroes every slot to and from it.  The same
+masks work on a live `Inbox` between ticks (host-side chaos) and inside a
+jitted schedule (deterministic simulated-time property tests).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.core.state import Inbox
+
+
+def drop_messages(inbox: Inbox, drop: jax.Array) -> Inbox:
+    """Drop messages by mask.
+
+    Args:
+      inbox: stacked cluster inbox, leaves [P_dst, G, P_src, ...] (or a
+        single peer's inbox [G, P_src, ...]).
+      drop: bool mask broadcastable to [P_dst, G, P_src] (resp. [G, P_src]);
+        True = the message in that slot is lost.
+    """
+    keep = ~drop
+
+    def mask(x):
+        m = keep
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        return jnp.where(m, x, jnp.zeros_like(x))
+
+    return jax.tree.map(mask, inbox)
+
+
+def random_drop(inbox: Inbox, key: jax.Array, p_drop: float) -> Inbox:
+    """Drop each message slot independently with probability p_drop."""
+    shape = inbox.v_type.shape  # [..., G, P_src]
+    drop = jax.random.bernoulli(key, p_drop, shape)
+    return drop_messages(inbox, drop)
+
+
+def partition_peer(inbox: Inbox, peer: int | jax.Array) -> Inbox:
+    """Isolate one peer of a stacked cluster inbox: nothing in, nothing out.
+
+    inbox leaves are [P_dst, G, P_src, ...]; we zero row dst==peer and
+    column src==peer, which is exactly a network partition of that peer in
+    the reference's rafthttp topology (reference raft.go:180-184).
+    """
+    P = inbox.v_type.shape[0]
+    dst = jnp.arange(P) == peer            # [P]
+    src = jnp.arange(P) == peer            # [P]
+    drop = dst[:, None, None] | src[None, None, :]   # [P, 1, P]
+    drop = jnp.broadcast_to(drop, inbox.v_type.shape)
+    return drop_messages(inbox, drop)

@@ -1,0 +1,86 @@
+"""In-process transport: the reference test harness's localhost cluster
+(reference raftsql_test.go:16-28) without sockets.
+
+Batches still round-trip through the binary codec so every test exercises
+the real wire format.  Delivery is synchronous on the sender's thread into
+the receiver's staging (RaftNode.deliver is non-blocking: it only appends
+to staging dicts under a lock).
+
+A `FaultPlan` may drop batches between specific nodes — the host-plane
+counterpart of transport.faults for the device plane.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from raftsql_tpu.transport.base import TickBatch, Transport
+from raftsql_tpu.transport.codec import decode_batch, encode_batch
+
+
+class FaultPlan:
+    """Mutable set of blocked (src, dst) node pairs."""
+
+    def __init__(self):
+        self._blocked: Set[Tuple[int, int]] = set()
+        self._lock = threading.Lock()
+
+    def isolate(self, node: int, universe: range) -> None:
+        with self._lock:
+            for other in universe:
+                self._blocked.add((node, other))
+                self._blocked.add((other, node))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._blocked.clear()
+
+    def blocked(self, src: int, dst: int) -> bool:
+        with self._lock:
+            return (src, dst) in self._blocked
+
+
+class LoopbackHub:
+    """Shared registry wiring N LoopbackTransports together."""
+
+    def __init__(self, faults: Optional[FaultPlan] = None):
+        self._nodes: Dict[int, Callable[[int, TickBatch], None]] = {}
+        self._lock = threading.Lock()
+        self.faults = faults or FaultPlan()
+
+    def attach(self, node_id: int,
+               deliver: Callable[[int, TickBatch], None]) -> None:
+        with self._lock:
+            self._nodes[node_id] = deliver
+
+    def detach(self, node_id: int) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def route(self, src: int, dst: int, blob: bytes) -> None:
+        if self.faults.blocked(src, dst):
+            return
+        with self._lock:
+            deliver = self._nodes.get(dst)
+        if deliver is not None:            # absent peer == dropped message
+            deliver(src, decode_batch(blob))
+
+
+class LoopbackTransport(Transport):
+    def __init__(self, hub: LoopbackHub):
+        self.hub = hub
+        self.node_id = -1
+
+    def start(self, node_id: int,
+              deliver: Callable[[int, TickBatch], None],
+              on_error: Callable[[Exception], None]) -> None:
+        self.node_id = node_id
+        self.hub.attach(node_id, deliver)
+
+    def send(self, dst: int, batch: TickBatch) -> None:
+        if batch.empty():
+            return
+        self.hub.route(self.node_id, dst, encode_batch(batch))
+
+    def stop(self) -> None:
+        self.hub.detach(self.node_id)

@@ -1,0 +1,194 @@
+"""TCP transport — the DCN peer message plane between hosts.
+
+Replaces the reference's vendored `etcd/rafthttp` streams (reference
+raft.go:170-184, 248-266) with persistent length-prefixed-frame TCP
+connections carrying encoded TickBatches:
+
+    frame := u32 payload_len | u32 src_node_id | payload(TickBatch codec)
+
+Liveness model matches rafthttp's: outbound sends to unreachable peers are
+dropped (raft re-sends every heartbeat tick), reconnection is automatic
+with backoff, and only *local* listener failure is fatal — it surfaces via
+on_error and tears the node down (reference raft.go:237-239).
+
+Each peer gets a dedicated sender thread with a bounded queue so a slow or
+dead peer can never stall the tick loop.  Accepted connections get TCP
+keepalive, standing in for the reference's 3-minute keepalive period
+(listener.go:55-57).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from raftsql_tpu.transport.base import TickBatch, Transport
+from raftsql_tpu.transport.codec import decode_batch, encode_batch
+
+log = logging.getLogger("raftsql_tpu.tcp")
+
+_FRAME = struct.Struct("<II")
+_RECONNECT_S = 0.2
+_QUEUE_CAP = 1024
+
+
+def parse_peer_url(url: str) -> Tuple[str, int]:
+    """Accept the reference's peer URL form `http://host:port`
+    (Procfile:2-4) or bare `host:port`."""
+    hostport = url.split("://", 1)[-1].rstrip("/")
+    host, port = hostport.rsplit(":", 1)
+    return host, int(port)
+
+
+class _PeerSender(threading.Thread):
+    def __init__(self, src_id: int, addr: Tuple[str, int],
+                 stop_evt: threading.Event):
+        super().__init__(daemon=True, name=f"tcp-send-{addr[1]}")
+        self.src_id = src_id
+        self.addr = addr
+        self.q: "queue.Queue[bytes]" = queue.Queue(maxsize=_QUEUE_CAP)
+        self._stop = stop_evt
+        self._sock: Optional[socket.socket] = None
+
+    def offer(self, blob: bytes) -> None:
+        try:
+            self.q.put_nowait(blob)
+        except queue.Full:        # drop-oldest: raft re-sends anyway
+            try:
+                self.q.get_nowait()
+                self.q.put_nowait(blob)
+            except queue.Empty:
+                pass
+
+    def _connect(self) -> Optional[socket.socket]:
+        try:
+            s = socket.create_connection(self.addr, timeout=1.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            return s
+        except OSError:
+            return None
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                blob = self.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            sent = False
+            while not sent and not self._stop.is_set():
+                if self._sock is None:
+                    self._sock = self._connect()
+                    if self._sock is None:
+                        time.sleep(_RECONNECT_S)
+                        # Peer down: drop this batch, drain stale queue.
+                        break
+                try:
+                    self._sock.sendall(
+                        _FRAME.pack(len(blob), self.src_id) + blob)
+                    sent = True
+                except OSError:
+                    try:
+                        self._sock.close()
+                    finally:
+                        self._sock = None
+        if self._sock is not None:
+            self._sock.close()
+
+
+class TcpTransport(Transport):
+    def __init__(self, peer_urls: List[str], self_index: int):
+        """peer_urls[i] is node i+1's address (reference raft.go:148-151:
+        node i serves at peers[i-1])."""
+        self.addrs = [parse_peer_url(u) for u in peer_urls]
+        self.self_index = self_index          # 0-based
+        self._stop_evt = threading.Event()
+        self._senders: Dict[int, _PeerSender] = {}
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: List[threading.Thread] = []
+        self._deliver: Callable[[int, TickBatch], None] = lambda s, b: None
+        self._on_error: Callable[[Exception], None] = lambda e: None
+
+    def start(self, node_id: int, deliver, on_error) -> None:
+        self._deliver = deliver
+        self._on_error = on_error
+        host, port = self.addrs[self.self_index]
+        try:
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ls.bind((host if host not in ("localhost",) else "127.0.0.1",
+                     port))
+            ls.listen(16)
+            ls.settimeout(0.2)
+        except OSError as e:
+            on_error(e)
+            return
+        self._listener = ls
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"tcp-accept-{port}")
+        self._accept_thread.start()
+        for i, addr in enumerate(self.addrs):
+            if i != self.self_index:
+                s = _PeerSender(node_id, addr, self._stop_evt)
+                s.start()
+                self._senders[i + 1] = s
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._stop_evt.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError as e:
+                if not self._stop_evt.is_set():
+                    self._on_error(e)
+                return
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            t = threading.Thread(target=self._recv_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        buf = b""
+        conn.settimeout(0.5)
+        try:
+            while not self._stop_evt.is_set():
+                while len(buf) >= _FRAME.size:
+                    plen, src = _FRAME.unpack_from(buf)
+                    if len(buf) < _FRAME.size + plen:
+                        break
+                    payload = buf[_FRAME.size:_FRAME.size + plen]
+                    buf = buf[_FRAME.size + plen:]
+                    self._deliver(src, decode_batch(payload))
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    return
+                buf += chunk
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def send(self, dst: int, batch: TickBatch) -> None:
+        if batch.empty():
+            return
+        sender = self._senders.get(dst)
+        if sender is not None:
+            sender.offer(encode_batch(batch))
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._listener is not None:
+            self._listener.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)

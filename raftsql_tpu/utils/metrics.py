@@ -1,0 +1,57 @@
+"""Node counters + timing helpers.
+
+The reference instantiates etcd's ServerStats/LeaderStats only to satisfy
+the transport (reference raft.go:167-176) and never reads them; SURVEY.md
+§5.5 asks for real per-node counters instead, exported via the HTTP API
+(`GET /metrics` in api/http.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeMetrics:
+    ticks: int = 0
+    proposals: int = 0
+    commits: int = 0
+    msgs_sent: int = 0
+    started_at: float = field(default_factory=time.monotonic)
+
+    def snapshot(self) -> dict:
+        up = max(time.monotonic() - self.started_at, 1e-9)
+        return {
+            "ticks": self.ticks,
+            "proposals": self.proposals,
+            "commits": self.commits,
+            "msgs_sent": self.msgs_sent,
+            "uptime_s": round(up, 3),
+            "commits_per_s": round(self.commits / up, 3),
+        }
+
+    def render(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True) + "\n"
+
+
+class LatencyTimer:
+    """Thread-safe propose→commit latency sampler (p50 north-star metric)."""
+
+    def __init__(self, cap: int = 4096):
+        self._samples: list[float] = []
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._samples) < self._cap:
+                self._samples.append(seconds)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            s = sorted(self._samples)
+            return s[min(int(q * len(s)), len(s) - 1)]

@@ -1,10 +1,17 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
-Tests must run without TPU hardware and must exercise multi-device sharding,
-so we ask XLA for 8 host-platform devices before jax initializes.  This is
-the multi-node-without-a-real-cluster trick of the reference test harness
+Tests must run without TPU hardware and must exercise multi-device
+sharding, so we ask XLA for 8 host-platform devices.  This is the
+multi-node-without-a-real-cluster trick of the reference test harness
 (reference raftsql_test.go:16-28, loopback TCP on localhost ports) in its
 TPU-native form.
+
+IMPORTANT: this environment's `sitecustomize` imports jax at interpreter
+startup and registers the remote-TPU ("axon") backend, so jax's
+`jax_platforms` config was already captured from the environment before
+this conftest runs.  Setting os.environ here is too late — we must update
+the live jax config, otherwise every test computation silently round-trips
+through the single shared TPU tunnel (and concurrent test runs wedge it).
 """
 import os
 import sys
@@ -16,3 +23,8 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"

@@ -1,0 +1,163 @@
+"""Property tests: raft safety invariants under adversarial schedules.
+
+SURVEY.md §4 notes the reference has no property/fuzz testing of its
+consensus core (it trusts vendored etcd/raft).  The batched JAX core makes
+this cheap: deterministic simulated time, seeded message loss and
+partitions, invariants checked over the full [P, G] state every tick.
+
+Invariants (raft paper §5.4):
+  * Election Safety   — at most one leader per term per group.
+  * Log Matching      — if two logs hold an entry with the same index and
+                        term, the logs are identical up through that index.
+  * Leader Completeness / State Machine Safety — committed (index, term)
+                        pairs are never contradicted later on any peer.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raftsql_tpu.config import LEADER, RaftConfig
+from raftsql_tpu.core.cluster import (cluster_step_jit, empty_cluster_inbox,
+                                      init_cluster_state)
+from raftsql_tpu.core.state import term_at
+from raftsql_tpu.transport.faults import partition_peer, random_drop
+
+
+def window_terms(states, cfg):
+    """[P, G, L] materialized log terms (L = max log_len), 0 beyond len."""
+    L = int(np.asarray(states.log_len).max())
+    if L == 0:
+        return np.zeros((cfg.num_peers, cfg.num_groups, 0), np.int64)
+    idx = jnp.arange(1, L + 1, dtype=jnp.int32)[None, :]
+    out = []
+    for p in range(cfg.num_peers):
+        t = term_at(states.log_term[p], states.log_len[p],
+                    jnp.broadcast_to(idx, (cfg.num_groups, L)),
+                    cfg.log_window)
+        out.append(np.asarray(t))
+    return np.stack(out)
+
+
+class InvariantChecker:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        # Per (group): history of leaders per term, and the highest
+        # committed prefix observed with its terms.
+        self.leader_of_term = {}             # (g, term) -> peer
+        self.committed = {}                  # g -> list of terms, 1-based
+
+    def check(self, states, t):
+        cfg = self.cfg
+        role = np.asarray(states.role)
+        term = np.asarray(states.term)
+        commit = np.asarray(states.commit)
+        log_len = np.asarray(states.log_len)
+        terms = window_terms(states, cfg)    # [P, G, L]
+
+        for g in range(cfg.num_groups):
+            # Election safety.
+            for p in range(cfg.num_peers):
+                if role[p, g] == LEADER:
+                    prev = self.leader_of_term.setdefault((g, term[p, g]), p)
+                    assert prev == p, (
+                        f"t={t} g={g}: two leaders ({prev},{p}) "
+                        f"in term {term[p, g]}")
+            # Log matching over committed prefixes + leader completeness.
+            hist = self.committed.setdefault(g, [])
+            for p in range(cfg.num_peers):
+                c = int(commit[p, g])
+                assert c <= log_len[p, g]
+                pterms = terms[p, g, :c].tolist()
+                overlap = min(len(hist), c)
+                assert hist[:overlap] == pterms[:overlap], (
+                    f"t={t} g={g} p={p}: committed prefix diverged: "
+                    f"{hist[:overlap]} vs {pterms[:overlap]}")
+                if c > len(hist):
+                    self.committed[g] = pterms
+
+
+def run_chaos(cfg, ticks, p_drop=0.0, partition_schedule=(), prop_rate=0.3,
+              seed=0):
+    """Run a cluster under chaos, checking invariants every tick."""
+    states = init_cluster_state(cfg)
+    inboxes = empty_cluster_inbox(cfg)
+    checker = InvariantChecker(cfg)
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed + 1)
+    for t in range(ticks):
+        if p_drop > 0:
+            key, sub = jax.random.split(key)
+            inboxes = random_drop(inboxes, sub, p_drop)
+        for (t0, t1, peer) in partition_schedule:
+            if t0 <= t < t1:
+                inboxes = partition_peer(inboxes, peer)
+        props = jnp.asarray(
+            (rng.random((cfg.num_peers, cfg.num_groups)) < prop_rate)
+            .astype(np.int32))
+        states, inboxes, _ = cluster_step_jit(cfg, states, inboxes, props)
+        checker.check(states, t)
+    return states, checker
+
+
+CFG = dict(num_groups=4, num_peers=3, log_window=64, max_entries_per_msg=4,
+           election_ticks=10, heartbeat_ticks=1)
+
+
+class TestSafetyUnderChaos:
+    def test_invariants_no_faults(self):
+        cfg = RaftConfig(seed=1, **CFG)
+        states, _ = run_chaos(cfg, 120, seed=1)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+    @pytest.mark.parametrize("p_drop,seed", [(0.1, 2), (0.3, 3), (0.5, 4)])
+    def test_invariants_under_message_loss(self, p_drop, seed):
+        cfg = RaftConfig(seed=seed, **CFG)
+        states, _ = run_chaos(cfg, 150, p_drop=p_drop, seed=seed)
+        if p_drop <= 0.3:   # liveness only asserted under moderate loss
+            assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+    def test_invariants_under_rolling_partitions(self):
+        cfg = RaftConfig(seed=5, **CFG)
+        sched = [(30, 60, 0), (70, 100, 1), (110, 140, 2)]
+        states, _ = run_chaos(cfg, 160, partition_schedule=sched, seed=5)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+    def test_invariants_five_peers_loss_and_partition(self):
+        cfg = RaftConfig(seed=6, num_groups=2, num_peers=5, log_window=64,
+                         max_entries_per_msg=4)
+        states, _ = run_chaos(cfg, 150, p_drop=0.15,
+                              partition_schedule=[(40, 80, 2)], seed=6)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+    def test_committed_entries_survive_leader_churn(self):
+        # Partition whoever leads group 0, twice; committed data must persist.
+        cfg = RaftConfig(seed=7, **CFG)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        checker = InvariantChecker(cfg)
+        zero = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
+        t = 0
+
+        def tick(props, fault_peer=None):
+            nonlocal states, inboxes, t
+            if fault_peer is not None:
+                inboxes = partition_peer(inboxes, fault_peer)
+            states, inboxes, _ = cluster_step_jit(cfg, states, inboxes, props)
+            checker.check(states, t)
+            t += 1
+
+        for _ in range(60):
+            tick(zero)
+        for round_ in range(2):
+            role = np.asarray(states.role)
+            leader = int(role[:, 0].argmax())
+            props = jnp.asarray((role == LEADER).astype(np.int32) * 2)
+            tick(props)
+            commit_before = int(np.asarray(states.commit)[:, 0].max())
+            for _ in range(40):
+                tick(zero, fault_peer=leader)
+            for _ in range(40):
+                tick(zero)
+            commit_after = int(np.asarray(states.commit)[:, 0].max())
+            assert commit_after >= commit_before, "committed data lost"

@@ -1,0 +1,122 @@
+"""WAL + codec unit tests (durability and wire layers)."""
+import os
+
+import pytest
+
+from raftsql_tpu.config import MSG_REQ, MSG_RESP
+from raftsql_tpu.storage.wal import WAL, wal_exists
+from raftsql_tpu.transport.base import (AppendRec, ProposalRec, TickBatch,
+                                        VoteRec)
+from raftsql_tpu.transport.codec import decode_batch, encode_batch
+
+
+class TestWAL:
+    def test_roundtrip(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        w.append_entry(0, 1, 1, b"CREATE TABLE t")
+        w.append_entry(0, 2, 1, b"INSERT 1")
+        w.append_entry(1, 1, 2, b"other group")
+        w.set_hardstate(0, 1, 0, 2)
+        w.sync()
+        w.close()
+        assert wal_exists(d)
+        groups = WAL.replay(d)
+        assert groups[0].log_len == 2
+        assert groups[0].entries == [(1, b"CREATE TABLE t"), (1, b"INSERT 1")]
+        assert groups[0].hard.term == 1
+        assert groups[0].hard.commit == 2
+        assert groups[1].entries == [(2, b"other group")]
+
+    def test_conflict_truncation_on_replay(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        w.append_entry(0, 1, 1, b"a")
+        w.append_entry(0, 2, 1, b"b")
+        w.append_entry(0, 3, 1, b"c")
+        # Overwrite index 2 with a term-2 entry (leader change).
+        w.append_entry(0, 2, 2, b"b2")
+        w.close()
+        groups = WAL.replay(d)
+        assert groups[0].entries == [(1, b"a"), (2, b"b2")]
+
+    def test_torn_tail_dropped(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        w.append_entry(0, 1, 1, b"good")
+        w.close()
+        path = os.path.join(d, "wal-0.log")
+        with open(path, "ab") as f:
+            f.write(b"\x01\x02\x03garbage")
+        groups = WAL.replay(d)
+        assert groups[0].entries == [(1, b"good")]
+
+    def test_append_after_reopen(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        w.append_entry(0, 1, 1, b"one")
+        w.close()
+        w2 = WAL(d)
+        w2.append_entry(0, 2, 1, b"two")
+        w2.close()
+        groups = WAL.replay(d)
+        assert [e[1] for e in groups[0].entries] == [b"one", b"two"]
+
+    def test_empty_replay(self, tmp_path):
+        assert WAL.replay(str(tmp_path / "nope")) == {}
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        batch = TickBatch(
+            votes=[VoteRec(group=3, type=MSG_REQ, term=7, last_idx=9,
+                           last_term=6),
+                   VoteRec(group=0, type=MSG_RESP, term=7, granted=True)],
+            appends=[
+                AppendRec(group=2, type=MSG_REQ, term=5, prev_idx=10,
+                          prev_term=4, ent_terms=[5, 5],
+                          payloads=[b"INSERT a", b""], commit=9),
+                AppendRec(group=2, type=MSG_RESP, term=5, success=True,
+                          match=12),
+            ],
+            proposals=[ProposalRec(group=1, payload=b"CREATE TABLE x")])
+        out = decode_batch(encode_batch(batch))
+        assert out == batch
+
+    def test_empty(self):
+        assert decode_batch(encode_batch(TickBatch())).empty()
+
+    def test_payload_count_mismatch_asserts(self):
+        bad = TickBatch(appends=[AppendRec(
+            group=0, type=MSG_REQ, term=1, ent_terms=[1], payloads=[])])
+        with pytest.raises(AssertionError):
+            encode_batch(bad)
+
+
+class TestEnvelope:
+    def test_wrap_unwrap(self):
+        from raftsql_tpu.runtime.envelope import unwrap, wrap
+        data = wrap(b"INSERT INTO t VALUES (1)")
+        pid, payload = unwrap(data)
+        assert pid is not None
+        assert payload == b"INSERT INTO t VALUES (1)"
+
+    def test_bare_entries_pass_through(self):
+        from raftsql_tpu.runtime.envelope import unwrap
+        assert unwrap(b"") == (None, b"")
+
+    def test_distinct_ids(self):
+        from raftsql_tpu.runtime.envelope import unwrap, wrap
+        a, b = wrap(b"x"), wrap(b"x")
+        assert a != b
+        assert unwrap(a)[1] == unwrap(b)[1] == b"x"
+
+    def test_dedup_window(self):
+        from raftsql_tpu.runtime.envelope import DedupWindow
+        w = DedupWindow(cap=3)
+        assert not w.seen(1)
+        assert w.seen(1)           # duplicate caught
+        assert not w.seen(2)
+        assert not w.seen(3)
+        assert not w.seen(4)       # evicts 1
+        assert not w.seen(1)       # 1 slid out of the window
