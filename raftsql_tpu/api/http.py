@@ -15,7 +15,6 @@ Extensions beyond the reference (multi-group engine):
 """
 from __future__ import annotations
 
-import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -58,8 +57,15 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
 
         def do_PUT(self):
             try:
-                err = rdb.propose(self._body(),
-                                  self._group()).wait(timeout_s)
+                query, group = self._body(), self._group()
+                fut = rdb.propose(query, group)
+                try:
+                    err = fut.wait(timeout_s)
+                except TimeoutError:
+                    # Deregister the ack so it cannot leak (the statement
+                    # may still commit later; only this client gave up).
+                    rdb.abandon(query, group, fut)
+                    raise
             except Exception as e:
                 self._err(e)
                 return
@@ -70,8 +76,7 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
 
         def do_GET(self):
             if self.path == "/metrics":
-                self._send(200, (json.dumps(rdb.metrics(),
-                                            sort_keys=True) + "\n").encode(),
+                self._send(200, rdb.render_metrics().encode(),
                            ctype="application/json")
                 return
             try:
@@ -82,9 +87,9 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             self._send(200, rows.encode("utf-8"))
 
         def _method_not_allowed(self):
+            self._body()    # drain — a leftover body corrupts keep-alive
             self.send_response(405)
-            self.send_header("Allow", "PUT")
-            self.send_header("Allow", "GET")
+            self.send_header("Allow", "PUT, GET")
             body = b"Method not allowed\n"
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

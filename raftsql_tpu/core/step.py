@@ -42,7 +42,8 @@ from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
 
 
 def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
-              prop_n: jax.Array, self_id: jax.Array
+              prop_n: jax.Array, self_id: jax.Array,
+              group_offset: jax.Array | int = 0
               ) -> Tuple[PeerState, Outbox, StepInfo]:
     """Advance one peer's view of all G groups by one tick.
 
@@ -54,6 +55,10 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         (capped at cfg.max_entries_per_msg; host queues the rest).
       self_id: scalar i32 — this peer's 0-based id (traced, so the same
         compiled program serves every peer and vmaps over the peer axis).
+      group_offset: scalar i32 — global id of group row 0.  Election
+        jitter is drawn per GLOBAL group id, so a mesh-sharded run
+        (parallel/sharded.py, where this peer sees a G/gg-row block)
+        draws bit-identical timeouts to the single-chip run.
 
     Returns:
       (new_state, outbox, info).  `outbox[g, dst]` is the dense message set
@@ -211,8 +216,11 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     leader_hint = jnp.where(fire, NO_LEADER, leader_hint)
     elapsed = jnp.where(fire, 0, elapsed)
     key = jax.random.fold_in(state.rng, state.tick)
-    new_timeout = jax.random.randint(
-        key, (G,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    gids = jnp.asarray(group_offset, I32) + jnp.arange(G, dtype=I32)
+    new_timeout = jax.vmap(
+        lambda g: jax.random.randint(
+            jax.random.fold_in(key, g), (),
+            cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32))(gids)
     timeout = jnp.where(fire, new_timeout, state.timeout)
 
     hb = jnp.where(is_leader, state.hb_elapsed + 1, 0)
@@ -254,14 +262,19 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # Ring-window guard: every position this message reads (prev_s and the
     # batch entries) must still be inside the W-entry term ring, or the
     # gathered terms would be garbage from newer entries occupying the
-    # slots.  A follower lagging more than W entries gets no appends until
-    # host-mediated catch-up (runtime roadmap); it cannot win elections
-    # (log up-to-dateness check), so safety holds even while it stalls.
+    # slots.  A follower lagging more than W entries instead gets an EMPTY
+    # heartbeat at prev=0 (always matches, carries no entries, and its
+    # commit clamp min(leaderCommit, app_end=0) moves nothing) — this keeps
+    # its election timer reset so it cannot depose the live leader by
+    # starting elections, while actual catch-up is host-mediated
+    # (runtime roadmap).  It cannot win elections meanwhile (log
+    # up-to-dateness check), so safety holds while it lags.
     win_floor = log_len[:, None] - W                              # [G, 1]
     min_acc = jnp.where(prev_s > 0, prev_s,
                         jnp.where(n_s > 0, 1, 0))
     in_window = (min_acc == 0) | (min_acc > win_floor)
-    send_app = send_app & in_window
+    prev_s = jnp.where(in_window, prev_s, 0)
+    n_s = jnp.where(in_window, n_s, 0)
     prev_t_s = term_at(log_term, log_len, prev_s, W)
     ent_pos_s = prev_s[:, :, None] + 1 \
         + jnp.arange(E, dtype=I32)[None, None, :]                 # [G, P, E]

@@ -1,0 +1,11 @@
+"""Multi-chip SPMD execution of the batched consensus core."""
+
+from raftsql_tpu.parallel.sharded import (GROUPS_AXIS, PEERS_AXIS, make_mesh,
+                                          make_sharded_cluster_run,
+                                          make_sharded_cluster_step,
+                                          shard_cluster_arrays)
+
+__all__ = [
+    "GROUPS_AXIS", "PEERS_AXIS", "make_mesh", "make_sharded_cluster_run",
+    "make_sharded_cluster_step", "shard_cluster_arrays",
+]

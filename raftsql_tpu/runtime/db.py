@@ -122,6 +122,10 @@ class RaftDB:
         if is_select(query):
             fut.set(ValueError("expected non-SELECT"))
             return fut
+        if not 0 <= group < self.num_groups:
+            fut.set(ValueError(f"group {group} out of range "
+                               f"[0, {self.num_groups})"))
+            return fut
         with self._mu:
             if self._failed is not None:
                 fut.set(self._failed)
@@ -130,22 +134,51 @@ class RaftDB:
         self.pipe.propose(group, query.encode("utf-8"))
         return fut
 
+    def abandon(self, query: str, group: int, fut: AckFuture) -> None:
+        """Deregister a timed-out proposal's callback so it cannot leak in
+        `_q2cb` forever (the proposal itself may still commit later; its
+        apply is unaffected — only the ack is orphaned)."""
+        with self._mu:
+            cbs = self._q2cb.get((group, query))
+            if cbs is None:
+                return
+            try:
+                cbs.remove(fut)
+            except ValueError:
+                return
+            if not cbs:
+                del self._q2cb[(group, query)]
+
     def query(self, query: str, group: int = 0) -> str:
         """Local read — never touches consensus (db.go:123-130)."""
         if not is_select(query):
             raise ValueError("expected SELECT")
+        if not 0 <= group < self.num_groups:
+            raise ValueError(f"group {group} out of range "
+                             f"[0, {self.num_groups})")
         return self._sms[group].query(query)
 
     def metrics(self) -> dict:
         return self.pipe.node.metrics.snapshot()
 
+    def render_metrics(self) -> str:
+        return self.pipe.node.metrics.render()
+
     def close(self) -> Optional[Exception]:
+        """Shut down, failing (not leaking) any still-pending acks.
+
+        The reference fatals on pending acks (db.go:159-161); failing them
+        with an error instead is the conscious improvement — a node with
+        in-flight proposals at shutdown (e.g. lost quorum) must still be
+        able to close its WAL and state machines cleanly."""
         with self._mu:
             if self._closed:
                 return None
-            if self._q2cb:
-                raise RuntimeError("closing db with outstanding callbacks")
             self._closed = True
+            pending = [cb for cbs in self._q2cb.values() for cb in cbs]
+            self._q2cb.clear()
+        for cb in pending:
+            cb.set(RuntimeError("db closing with proposal outstanding"))
         err = self.pipe.close()
         self._reader.join(timeout=10)
         for sm in self._sms.values():

@@ -170,6 +170,9 @@ class RaftNode:
         The payload is wrapped with a unique envelope id so that
         forward-retries after leader failure apply exactly once
         (runtime/envelope.py)."""
+        if not 0 <= group < self.cfg.num_groups:
+            raise ValueError(f"group {group} out of range "
+                             f"[0, {self.cfg.num_groups})")
         with self._prop_lock:
             self._props[group].append(wrap(payload))
 

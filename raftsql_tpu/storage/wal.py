@@ -19,10 +19,15 @@ Record layout:  u32 crc32(body) | u32 body_len | body
   type 1 ENTRY:     u32 group | u64 index | u64 term | bytes data
   type 2 HARDSTATE: u32 group | u64 term | i64 vote | u64 commit
 
-Replay semantics match raft log truncation: a later ENTRY record at an
-index <= the current length truncates the log to index-1 first (conflict
-overwrite, see core/step.py Phase 4); the last HARDSTATE per group wins.
-A torn tail (bad CRC / short read) is dropped, like etcd's repair path.
+Replay semantics match raft's log-matching property: a later ENTRY record
+at an index <= the current length with the SAME term is an idempotent
+overwrite (a re-accepted duplicate append — same index+term implies same
+entry), while a DIFFERENT term is a genuine conflict and truncates the
+suffix from that index before appending (core/step.py Phase 4).  Truncating
+on same-term overlap would silently drop durably-acked suffix entries when
+a stale duplicate append covering only a prefix is re-accepted.  The last
+HARDSTATE per group wins.  A torn tail (bad CRC / short read) is dropped,
+like etcd's repair path.
 """
 from __future__ import annotations
 
@@ -127,9 +132,13 @@ class WAL:
                 _, group, index, term = _ENTRY.unpack_from(body)
                 data = body[_ENTRY.size:]
                 gl = groups.setdefault(group, GroupLog())
-                if index <= len(gl.entries):
-                    del gl.entries[index - 1:]      # conflict truncation
-                if index == len(gl.entries) + 1:
+                if 1 <= index <= len(gl.entries):
+                    if gl.entries[index - 1][0] == term:
+                        gl.entries[index - 1] = (term, data)
+                    else:                            # conflict truncation
+                        del gl.entries[index - 1:]
+                        gl.entries.append((term, data))
+                elif index == len(gl.entries) + 1:
                     gl.entries.append((term, data))
                 # else: a gap would mean WAL corruption; skip the record.
             elif rtype == REC_HARDSTATE:

@@ -34,6 +34,12 @@ log = logging.getLogger("raftsql_tpu.tcp")
 _FRAME = struct.Struct("<II")
 _RECONNECT_S = 0.2
 _QUEUE_CAP = 1024
+# Upper bound on an inbound frame.  The u32 length field would otherwise
+# let a corrupt or hostile peer make us buffer 4 GiB; a frame this large is
+# never legitimate (batches are bounded by max_entries_per_msg per group),
+# so the connection is dropped instead — the node itself must survive bad
+# peers (see runtime/node.py _deliver).
+_MAX_FRAME = 64 << 20
 
 
 def parse_peer_url(url: str) -> Tuple[str, int]:
@@ -162,6 +168,10 @@ class TcpTransport(Transport):
             while not self._stop_evt.is_set():
                 while len(buf) >= _FRAME.size:
                     plen, src = _FRAME.unpack_from(buf)
+                    if plen > _MAX_FRAME:
+                        log.warning("dropping connection: oversized frame "
+                                    "(%d bytes) from src %d", plen, src)
+                        return
                     if len(buf) < _FRAME.size + plen:
                         break
                     payload = buf[_FRAME.size:_FRAME.size + plen]

@@ -1,0 +1,105 @@
+"""HTTP API tests: reference semantics (PUT/GET/405, httpapi.go:36-66)
+plus the multi-group and robustness extensions."""
+import http.client
+import os
+
+import pytest
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.api.http import SQLServer
+from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+from raftsql_tpu.runtime.db import RaftDB
+from raftsql_tpu.runtime.pipe import RaftPipe
+from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+
+TIMEOUT = 30.0
+
+
+@pytest.fixture
+def server(tmp_path):
+    """Single-node cluster (self-elects) behind a real HTTP server."""
+    cfg = RaftConfig(num_groups=2, num_peers=1, tick_interval_s=0.005,
+                     log_window=64, max_entries_per_msg=4)
+    pipe = RaftPipe.create(1, 1, cfg, LoopbackTransport(LoopbackHub()),
+                           data_dir=str(tmp_path / "raftsql-1"))
+    rdb = RaftDB(lambda g: SQLiteStateMachine(
+        str(tmp_path / f"api-g{g}.db")), pipe, num_groups=2)
+    srv = SQLServer(0, rdb, host="127.0.0.1", timeout_s=TIMEOUT)
+    srv.start()
+    yield srv
+    srv.stop()
+    rdb.close()
+
+
+def req(srv, method, body=b"", headers=None, conn=None):
+    own = conn is None
+    if own:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request(method, "/", body=body, headers=headers or {})
+    r = conn.getresponse()
+    data = r.read()
+    if own:
+        conn.close()
+    return r, data
+
+
+def test_put_get_roundtrip(server):
+    r, _ = req(server, "PUT", b"CREATE TABLE main.t (v text)")
+    assert r.status == 204
+    r, _ = req(server, "PUT", b'INSERT INTO main.t (v) VALUES ("x")')
+    assert r.status == 204
+    r, data = req(server, "GET", b"SELECT * FROM main.t")
+    assert r.status == 200 and data == b"|x|\n"
+
+
+def test_group_header_out_of_range_is_400(server):
+    for g in ("-1", "5", "junk"):
+        r, data = req(server, "PUT", b"CREATE TABLE main.bad (v text)",
+                      headers={"X-Raft-Group": g})
+        assert r.status == 400, (g, r.status, data)
+    r, data = req(server, "GET", b"SELECT 1",
+                  headers={"X-Raft-Group": "7"})
+    assert r.status == 400
+
+
+def test_method_not_allowed_keeps_connection_usable(server):
+    """405 must drain the request body and emit one `Allow: PUT, GET`
+    header, or the keep-alive stream parses body bytes as the next
+    request (reference semantics: httpapi.go:63-66)."""
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        r, _ = req(server, "POST", b"some body that must be drained",
+                   conn=conn)
+        assert r.status == 405
+        assert r.getheader("Allow") == "PUT, GET"
+        # Same connection must still serve a clean request.
+        r, data = req(server, "GET", b"SELECT 42", conn=conn)
+        assert r.status == 200 and data == b"|42|\n"
+    finally:
+        conn.close()
+
+
+def test_metrics_endpoint(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        data = r.read()
+    finally:
+        conn.close()
+    assert r.status == 200
+    import json
+    m = json.loads(data)
+    assert {"ticks", "proposals", "commits", "msgs_sent"} <= set(m)
+
+
+def test_group_header_routes_to_second_group(server):
+    r, _ = req(server, "PUT", b"CREATE TABLE main.g1 (v text)",
+               headers={"X-Raft-Group": "1"})
+    assert r.status == 204
+    # group 0 must not see group 1's table.
+    r, data = req(server, "GET", b"SELECT * FROM main.g1")
+    assert r.status == 400
+    r, data = req(server, "GET", b"SELECT * FROM main.g1",
+                  headers={"X-Raft-Group": "1"})
+    assert r.status == 200

@@ -152,6 +152,51 @@ class TestReplication:
         assert (acc[np.asarray(states.role) != LEADER] == 0).all()
 
 
+def isolate_peer(inboxes, peer):
+    """Drop everything to and from `peer` (dense-inbox partition)."""
+    return jax.tree.map(
+        lambda x: x.at[peer].set(jnp.zeros((), x.dtype))
+                   .at[:, :, peer].set(jnp.zeros((), x.dtype)), inboxes)
+
+
+class TestLaggedFollower:
+    def test_out_of_window_follower_does_not_depose_leader(self):
+        """A follower lagging > log_window entries must keep receiving
+        (empty prev=0) heartbeats, or its election timer deposes the live
+        leader every timeout — sustained availability churn."""
+        cfg = small_cfg(num_groups=2, log_window=16, max_entries_per_msg=4,
+                        seed=2)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 100)
+        assert (leaders_per_group(states, cfg) == 1).all()
+
+        # Partition peer 2; commit W+ entries with the remaining quorum.
+        lag = 2
+        for _ in range(60):
+            role = np.asarray(states.role)
+            props = jnp.asarray((role == LEADER).astype(np.int32) * 2)
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
+            inboxes = isolate_peer(inboxes, lag)
+        gap = (np.asarray(states.log_len).max(axis=0)
+               - np.asarray(states.log_len)[lag])
+        assert (gap > cfg.log_window).all(), gap
+
+        # Heal.  The rejoining follower's inflated term may depose the
+        # leader ONCE (no prevote); after that the cluster must settle.
+        zero = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
+        for _ in range(80):
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, zero)
+        settled_term = np.asarray(states.term).max(axis=0).copy()
+        assert (leaders_per_group(states, cfg) == 1).all()
+        for _ in range(120):
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, zero)
+        final_term = np.asarray(states.term).max(axis=0)
+        assert (final_term == settled_term).all(), (
+            f"terms churned after settling: {settled_term} -> {final_term}")
+        assert (leaders_per_group(states, cfg) == 1).all()
+
+
 class TestCommitSafety:
     def test_commit_monotone(self):
         cfg = small_cfg(seed=11)

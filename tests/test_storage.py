@@ -40,6 +40,19 @@ class TestWAL:
         groups = WAL.replay(d)
         assert groups[0].entries == [(1, b"a"), (2, b"b2")]
 
+    def test_same_term_overlap_keeps_suffix(self, tmp_path):
+        """A re-accepted duplicate append (same index+term, e.g. a stale
+        retransmission) must NOT truncate durably-acked suffix entries —
+        same index+term implies same entry (raft log matching)."""
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        for i in range(1, 6):
+            w.append_entry(0, i, 1, f"e{i}".encode())
+        w.append_entry(0, 3, 1, b"e3")      # stale duplicate of entry 3
+        w.close()
+        gl = WAL.replay(d)[0]
+        assert gl.entries == [(1, f"e{i}".encode()) for i in range(1, 6)]
+
     def test_torn_tail_dropped(self, tmp_path):
         d = str(tmp_path / "w")
         w = WAL(d)
