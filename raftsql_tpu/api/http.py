@@ -76,6 +76,7 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
 
         def do_GET(self):
             if self.path == "/metrics":
+                self._body()    # drain — a leftover body corrupts keep-alive
                 self._send(200, rdb.render_metrics().encode(),
                            ctype="application/json")
                 return
@@ -91,9 +92,14 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             self.send_response(405)
             self.send_header("Allow", "PUT, GET")
             body = b"Method not allowed\n"
+            # HEAD responses must carry no body (a written body would be
+            # parsed as the next response on a keep-alive connection).
+            if self.command == "HEAD":
+                body = b""
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
-            self.wfile.write(body)
+            if body:
+                self.wfile.write(body)
 
         do_POST = _method_not_allowed
         do_DELETE = _method_not_allowed

@@ -130,6 +130,9 @@ class RaftDB:
             if self._failed is not None:
                 fut.set(self._failed)
                 return fut
+            if self._closed:
+                fut.set(RuntimeError("db is closed"))
+                return fut
             self._q2cb[(group, query)].append(fut)
         self.pipe.propose(group, query.encode("utf-8"))
         return fut
