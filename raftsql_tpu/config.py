@@ -58,6 +58,13 @@ class RaftConfig:
     # device step advances every group at once.
     tick_interval_s: float = 0.001
 
+    # Commit-advance kernel: "point" (etcd's maybeCommit shortcut — check
+    # only the quorum index), "windowed" (full masked scan of the ring,
+    # ops/commit_scan.py), or "pallas" (hand-written TPU kernel,
+    # ops/pallas_quorum.py).  All are safe; they differ in how eagerly an
+    # old-term quorum index commits and in lowering strategy.
+    commit_rule: str = "point"
+
     seed: int = 0
 
     def __post_init__(self):
@@ -71,6 +78,8 @@ class RaftConfig:
             raise ValueError("log_window must be >= 4*max_entries_per_msg")
         if self.election_ticks <= 2 * self.heartbeat_ticks:
             raise ValueError("election_ticks must be > 2*heartbeat_ticks")
+        if self.commit_rule not in ("point", "windowed", "pallas"):
+            raise ValueError(f"unknown commit_rule {self.commit_rule!r}")
 
     @property
     def quorum(self) -> int:

@@ -198,10 +198,23 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     match = jnp.where(is_leader[:, None] & self_onehot, log_len[:, None],
                       match)
 
-    # ---- Phase 7: leader commit advance — the quorum reduction kernel.
-    commit = quorum_commit_index(
-        match, log_term, log_len, commit, term, is_leader,
-        quorum=quorum, window=W)
+    # ---- Phase 7: leader commit advance — the quorum reduction kernel
+    # (selected by cfg.commit_rule; all implement raft Fig. 2's leader
+    # rule, see ops/commit_scan.py and ops/pallas_quorum.py).
+    if cfg.commit_rule == "windowed":
+        from raftsql_tpu.ops.commit_scan import windowed_commit_index
+        commit = windowed_commit_index(
+            match, log_term, log_len, commit, term, is_leader,
+            quorum=quorum, window=W)
+    elif cfg.commit_rule == "pallas":
+        from raftsql_tpu.ops.pallas_quorum import pallas_quorum_commit_index
+        commit = pallas_quorum_commit_index(
+            match, log_term, log_len, commit, term, is_leader,
+            quorum=quorum, window=W)
+    else:
+        commit = quorum_commit_index(
+            match, log_term, log_len, commit, term, is_leader,
+            quorum=quorum, window=W)
 
     # ---- Phase 8: timers and election start.
     reset = any_grant | any_app

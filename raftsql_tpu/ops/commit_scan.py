@@ -1,0 +1,77 @@
+"""Windowed commit-index advance + on-device commit trajectories.
+
+BASELINE.json config 4 ("100k groups, log-matching + per-group commit-index
+prefix scan"): the reference's commit advance lives inside vendored
+etcd/raft `maybeCommit`, driven once per Ready from the event loop
+(reference raft.go:224-235).  Here it is a dense kernel over all groups:
+
+  * `windowed_commit_index` — the full raft §5.3/§5.4.2 rule: advance to
+    the LARGEST log position n with commit < n <= quorum-match whose entry
+    term equals the leader's current term.  `ops.quorum.quorum_commit_index`
+    checks only n = quorum-match (etcd's shortcut, correct but weaker when
+    the quorum index sits on an old-term entry); the windowed form scans
+    every in-window position at once as a masked max — O(W) lanes, no loop.
+
+  * `running_commit` — an associative prefix scan (`lax.associative_scan`
+    over `jnp.maximum`) turning per-tick commit candidates [T, G] into the
+    monotone committed-index trajectory, entirely on device.  This is how
+    the benchmark harness derives propose→commit latency percentiles
+    without moving T x G arrays to the host.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def windowed_commit_index(match: jax.Array, log_term: jax.Array,
+                          log_len: jax.Array, commit: jax.Array,
+                          term: jax.Array, is_leader: jax.Array,
+                          *, quorum: int, window: int) -> jax.Array:
+    """[G, P] match + [G, W] term ring -> [G] advanced commit index.
+
+    For every ring position w holding log index n (reconstructed from
+    log_len, since position n lives at slot (n-1) % W and only the last W
+    indexes are resident), n is committable iff:
+      commit < n <= quorum_match  and  term_of(n) == current term.
+    The advance is the max committable n, or `commit` unchanged.
+    """
+    _, W = log_term.shape
+    P = match.shape[-1]
+    sorted_match = jnp.sort(match, axis=-1)
+    qmatch = sorted_match[..., P - quorum]                        # [G]
+
+    slot = jnp.arange(W, dtype=I32)[None, :]                      # [1, W]
+    # Log index currently resident in each ring slot: the unique
+    # n in (log_len - W, log_len] with (n-1) % W == slot.
+    base = log_len[:, None] - 1                                   # [G, 1]
+    n = base - (base - slot) % W + 1                              # [G, W]
+    committable = (n > commit[:, None]) & (n <= qmatch[:, None]) \
+        & (n >= 1) & (log_term == term[:, None])
+    best = jnp.max(jnp.where(committable, n, 0), axis=-1)         # [G]
+    ok = is_leader & (best > commit)
+    return jnp.where(ok, best, commit)
+
+
+def running_commit(candidates: jax.Array, axis: int = 0) -> jax.Array:
+    """Monotone prefix-max over the tick axis: [T, ...] -> [T, ...].
+
+    commit indexes never regress; given per-tick raw observations this
+    yields the committed-index trajectory as one `associative_scan`.
+    """
+    return jax.lax.associative_scan(jnp.maximum, candidates, axis=axis)
+
+
+def commit_latency_ticks(traj: jax.Array, targets: jax.Array) -> jax.Array:
+    """First tick at which each target index is committed.
+
+    traj: [T, G] monotone commit trajectory (from `running_commit`).
+    targets: [G] log index per group (e.g. prop_base + n of a proposal).
+    Returns [G] i32 tick of first commit >= target, or T if never.
+    """
+    T = traj.shape[0]
+    hit = traj >= targets[None, :]                                # [T, G]
+    first = jnp.argmax(hit, axis=0).astype(I32)
+    return jnp.where(hit.any(axis=0), first, T)

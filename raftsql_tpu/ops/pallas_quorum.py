@@ -1,0 +1,91 @@
+"""Hand-written Pallas TPU kernel for the quorum commit reduction.
+
+The quorum/commit advance (`ops.quorum.quorum_commit_index`) is the hot
+reduction of the batched consensus step — the math of vendored etcd/raft's
+`maybeCommit` (driven from the reference's event loop, raft.go:224-235)
+over ALL groups at once.  XLA's fused sort+gather handles it well at small
+P; this kernel removes the sort entirely:
+
+  q-th largest of P match values == max_i { match[i] : #{j : match[j] >=
+  match[i]} >= quorum }
+
+which is an O(P^2) comparison network — P static VPU passes over a [Gb, P]
+block, no data movement.  The entry-term lookup is a one-hot reduction over
+the ring axis instead of a gather (gathers are the thing to avoid on the
+VPU; a masked sum over W lanes fuses).
+
+Blocks stream G in `block_g`-row tiles through VMEM; all shapes static.
+On non-TPU backends the kernel runs in interpreter mode (slow, but keeps
+tests hermetic on the CPU CI platform).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+I32 = jnp.int32
+
+
+def _kernel(quorum: int, window: int,
+            match_ref, log_term_ref, log_len_ref, commit_ref, term_ref,
+            leader_ref, out_ref):
+    match = match_ref[:]                      # [Gb, P]
+    ring = log_term_ref[:]                    # [Gb, W]
+    log_len = log_len_ref[:]                  # [Gb, 1]
+    commit = commit_ref[:]                    # [Gb, 1]
+    term = term_ref[:]                        # [Gb, 1]
+    is_leader = leader_ref[:] != 0            # [Gb, 1]
+    P = match.shape[-1]
+
+    # q-th largest via the comparison network (static P-pass loop).
+    cand = jnp.zeros_like(commit)             # [Gb, 1]
+    for i in range(P):
+        mi = match[:, i:i + 1]                # [Gb, 1]
+        cnt = jnp.sum((match >= mi).astype(I32), axis=-1, keepdims=True)
+        cand = jnp.where((cnt >= quorum) & (mi > cand), mi, cand)
+
+    # term_of(cand) without a gather: one-hot over the ring axis.
+    slot = (cand - 1) % window                # [Gb, 1]
+    lanes = jax.lax.broadcasted_iota(I32, ring.shape, 1)
+    cand_term = jnp.sum(jnp.where(lanes == slot, ring, 0), axis=-1,
+                        keepdims=True)
+    valid = (cand >= 1) & (cand <= log_len)
+    cand_term = jnp.where(valid, cand_term, 0)
+
+    ok = is_leader & (cand_term == term) & (cand > commit)
+    out_ref[:] = jnp.where(ok, cand, commit)
+
+
+def pallas_quorum_commit_index(match: jax.Array, log_term: jax.Array,
+                               log_len: jax.Array, commit: jax.Array,
+                               term: jax.Array, is_leader: jax.Array,
+                               *, quorum: int, window: int,
+                               block_g: int = 1024,
+                               interpret: bool | None = None) -> jax.Array:
+    """Drop-in replacement for `ops.quorum.quorum_commit_index`."""
+    G, P = match.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    gb = min(block_g, G)
+    pad = (-G) % gb
+    col = lambda x: x.astype(I32).reshape(G, 1)
+    args = (match.astype(I32), log_term.astype(I32), col(log_len),
+            col(commit), col(term), col(is_leader))
+    if pad:
+        args = tuple(jnp.pad(x, ((0, pad), (0, 0))) for x in args)
+    gp = G + pad
+
+    widths = (P, window, 1, 1, 1, 1)
+    out = pl.pallas_call(
+        functools.partial(_kernel, quorum, window),
+        grid=(gp // gb,),
+        in_specs=[pl.BlockSpec((gb, w), lambda i: (i, 0)) for w in widths],
+        out_specs=pl.BlockSpec((gb, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((gp, 1), I32),
+        interpret=interpret,
+    )(*args)
+    return out[:G, 0]

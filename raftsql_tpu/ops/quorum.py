@@ -19,8 +19,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.core import state as _state
-
 
 def quorum_match_index(match: jax.Array, quorum: int) -> jax.Array:
     """[G, P] match matrix -> [G] q-th largest match index per group."""
@@ -34,8 +32,12 @@ def quorum_commit_index(match: jax.Array, log_term: jax.Array,
                         term: jax.Array, is_leader: jax.Array,
                         *, quorum: int, window: int) -> jax.Array:
     """Advance per-group commit indexes for leader rows; monotone for all."""
+    # Deferred import: core.step imports this module, so a module-level
+    # import of core.state would be circular when ops loads first.
+    from raftsql_tpu.core.state import term_at
+
     cand = quorum_match_index(match, quorum)
-    cand_term = _state.term_at(log_term, log_len, cand, window)
+    cand_term = term_at(log_term, log_len, cand, window)
     ok = is_leader & (cand_term == term) & (cand > commit)
     return jnp.where(ok, cand, commit)
 
