@@ -1,0 +1,9 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; the host runtime's hot I/O paths are C++
+(this package), mirroring how the reference leans on native code for its
+storage engine (SQLite via cgo, reference db.go:6).  Everything here is
+optional at runtime: each component has a pure-Python fallback so the
+framework works on machines without a toolchain.
+"""
+from raftsql_tpu.native.build import load_native_wal  # noqa: F401

@@ -1,0 +1,105 @@
+"""Build + load the native components (ctypes, on-demand g++ compile).
+
+pybind11 is not available in this environment, so the native pieces expose
+a plain C ABI consumed through ctypes.  The shared object is compiled
+next to the source on first use and cached by source mtime; failures of
+any kind (no compiler, read-only checkout) degrade to the pure-Python
+implementations.
+
+Set RAFTSQL_TPU_NATIVE=0 to force the Python fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+log = logging.getLogger("raftsql_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _build(src: str, so: str) -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable (%s); using Python fallback",
+                    e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed; using Python fallback:\n%s",
+                    proc.stderr)
+        return False
+    return True
+
+
+def _load(name: str):
+    """Compile (if stale) and dlopen native/<name>.cc -> CDLL or None."""
+    if os.environ.get("RAFTSQL_TPU_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.cc")
+        so = os.path.join(_DIR, f"_native_{name}.so")
+        lib = None
+        try:
+            if not os.path.isfile(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src):
+                # Build in a temp file then rename, so concurrent
+                # processes never dlopen a half-written object.
+                fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so")
+                os.close(fd)
+                if _build(src, tmp):
+                    os.replace(tmp, so)
+                else:
+                    os.unlink(tmp)
+                    _cache[name] = None
+                    return None
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            log.warning("native %s load failed (%s); Python fallback",
+                        name, e)
+            lib = None
+        _cache[name] = lib
+        return lib
+
+
+def load_native_wal():
+    """ctypes handle to the WAL fast path, or None."""
+    lib = _load("wal")
+    if lib is None:
+        return None
+    try:
+        lib.wal_open.restype = ctypes.c_void_p
+        lib.wal_open.argtypes = [ctypes.c_char_p]
+        lib.wal_append_entry.restype = ctypes.c_int
+        lib.wal_append_entry.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
+        lib.wal_append_entries.restype = ctypes.c_int
+        lib.wal_append_entries.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32)]
+        lib.wal_set_hardstate.restype = ctypes.c_int
+        lib.wal_set_hardstate.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_uint64]
+        lib.wal_sync.restype = ctypes.c_int
+        lib.wal_sync.argtypes = [ctypes.c_void_p]
+        lib.wal_close.restype = ctypes.c_int
+        lib.wal_close.argtypes = [ctypes.c_void_p]
+    except AttributeError as e:     # pragma: no cover - corrupt build
+        log.warning("native wal ABI mismatch (%s); Python fallback", e)
+        return None
+    return lib

@@ -299,23 +299,38 @@ class RaftNode:
         return inbox, apps
 
     def _wal_phase(self, info) -> None:
-        """Persist this tick's appends + hard-state changes, one fsync."""
+        """Persist this tick's appends + hard-state changes, one fsync.
+
+        Entry records are accumulated across all groups and written with
+        ONE batched WAL call (the C++ fast path frames them without a
+        per-record Python round trip — native/wal.cc)."""
         G = self.cfg.num_groups
         term = info.term
+        w_groups: List[int] = []
+        w_idx: List[int] = []
+        w_terms: List[int] = []
+        w_data: List[bytes] = []
+        hard_changes: List[Tuple[int, Tuple[int, int, int]]] = []
+
+        def put_rec(g: int, idx: int, t: int, data: bytes) -> None:
+            w_groups.append(g)
+            w_idx.append(idx)
+            w_terms.append(t)
+            w_data.append(data)
+
         for g in range(G):
             n_acc = int(info.prop_accepted[g])
             if info.noop[g] or n_acc:
                 base = int(info.prop_base[g])
                 if info.noop[g]:
-                    self.wal.append_entry(g, base, int(term[g]), b"")
+                    put_rec(g, base, int(term[g]), b"")
                     self.payload_log.put(g, base, [b""])
                 if n_acc:
                     with self._prop_lock:
                         batch = [self._props[g].popleft()
                                  for _ in range(n_acc)]
                     for i, data in enumerate(batch):
-                        self.wal.append_entry(g, base + 1 + i,
-                                              int(term[g]), data)
+                        put_rec(g, base + 1 + i, int(term[g]), data)
                     self.payload_log.put(g, base + 1, batch)
                 self.metrics.proposals += n_acc
             src = int(info.app_from[g])
@@ -326,8 +341,8 @@ class RaftNode:
                 start = int(info.app_start[g])
                 new_len = int(info.new_log_len[g])
                 for i in range(int(info.app_n[g])):
-                    self.wal.append_entry(g, start + i, rec.ent_terms[i],
-                                          rec.payloads[i])
+                    put_rec(g, start + i, rec.ent_terms[i],
+                            rec.payloads[i])
                 self.payload_log.put(g, start, rec.payloads,
                                      new_len=new_len)
                 if info.app_conflict[g] and self._applied[g] >= start:
@@ -341,8 +356,14 @@ class RaftNode:
                     self._applied[g] = min(self._applied[g], start - 1)
             hs = (int(term[g]), int(info.voted_for[g]), int(info.commit[g]))
             if self._hard_cache.get(g) != hs:
-                self.wal.set_hardstate(g, *hs)
+                hard_changes.append((g, hs))
                 self._hard_cache[g] = hs
+        # Entries land before hard states (etcd wal.Save order): a torn
+        # tail can then never leave a hard state referencing lost entries.
+        if w_groups:
+            self.wal.append_entries(w_groups, w_idx, w_terms, w_data)
+        for g, hs in hard_changes:
+            self.wal.set_hardstate(g, *hs)
         self.wal.sync()
 
     def _send_phase(self, outbox, info) -> None:

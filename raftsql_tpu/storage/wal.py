@@ -73,16 +73,35 @@ class WAL:
     """Append-only multi-group WAL with batched fsync.
 
     Usage per tick (the reference's Ready handling, raft.go:227-235):
-        wal.begin()
         wal.append_entry(...); wal.set_hardstate(...)
         wal.sync()              # durable point — only now send/publish
+
+    The write path prefers the C++ fast path (native/wal.cc — framing,
+    CRC, buffered write, fdatasync behind one ctypes call) and falls back
+    to pure Python; both produce byte-identical files, and `replay` reads
+    either.  `native=None` auto-detects; True/False force.
     """
 
-    def __init__(self, dirname: str):
+    def __init__(self, dirname: str, native: Optional[bool] = None):
         os.makedirs(dirname, exist_ok=True)
         self.path = os.path.join(dirname, WAL_FILE)
-        self._f = open(self.path, "ab")
+        self._lib = None
+        self._h = None
+        if native is not False:
+            from raftsql_tpu.native.build import load_native_wal
+            lib = load_native_wal()
+            if lib is not None:
+                h = lib.wal_open(self.path.encode())
+                if h:
+                    self._lib, self._h = lib, h
+            if native is True and self._lib is None:
+                raise RuntimeError("native WAL requested but unavailable")
+        self._f = None if self._lib else open(self.path, "ab")
         self._pending = False
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
 
     # -- write path ------------------------------------------------------
 
@@ -93,21 +112,64 @@ class WAL:
 
     def append_entry(self, group: int, index: int, term: int,
                      data: bytes) -> None:
+        if self._lib is not None:
+            self._lib.wal_append_entry(self._h, group, index, term,
+                                       data, len(data))
+            self._pending = True
+            return
         self._write(_ENTRY.pack(REC_ENTRY, group, index, term) + data)
+
+    def append_entries(self, groups, indexes, terms, datas) -> None:
+        """Batched append — one native call for a whole tick's records."""
+        if self._lib is None:
+            for g, i, t, d in zip(groups, indexes, terms, datas):
+                self.append_entry(g, i, t, d)
+            return
+        import ctypes
+        n = len(groups)
+        if n == 0:
+            return
+        blob = b"".join(datas)
+        self._lib.wal_append_entries(
+            self._h, n,
+            (ctypes.c_uint32 * n)(*groups),
+            (ctypes.c_uint64 * n)(*indexes),
+            (ctypes.c_uint64 * n)(*terms),
+            blob,
+            (ctypes.c_uint32 * n)(*[len(d) for d in datas]))
+        self._pending = True
 
     def set_hardstate(self, group: int, term: int, vote: int,
                       commit: int) -> None:
+        if self._lib is not None:
+            self._lib.wal_set_hardstate(self._h, group, term, vote, commit)
+            self._pending = True
+            return
         self._write(_HARD.pack(REC_HARDSTATE, group, term, vote, commit))
 
     def sync(self) -> None:
-        if self._pending:
+        if not self._pending:
+            return
+        if self._lib is not None:
+            if self._lib.wal_sync(self._h) != 0:
+                raise OSError("native WAL sync failed")
+        else:
             self._f.flush()
             os.fsync(self._f.fileno())
-            self._pending = False
+        self._pending = False
 
     def close(self) -> None:
-        self.sync()
-        self._f.close()
+        if self._lib is not None:
+            lib, self._lib = self._lib, None
+            rc = lib.wal_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise OSError("native WAL close failed (unsynced records "
+                              "may be lost)")
+            return
+        if self._f is not None:
+            self.sync()
+            self._f.close()
 
     # -- replay ----------------------------------------------------------
 
