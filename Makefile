@@ -1,0 +1,29 @@
+# Build/test harness — parity with the reference Makefile (build, test,
+# vet targets; reference Makefile:1-23), adapted to the Python/C++ tree.
+
+PY ?= python
+
+.PHONY: all native test vet bench clean
+
+# "Build" = compile the native C++ components (storage fast path).
+all: native
+
+native:
+	$(PY) -c "from raftsql_tpu.native.build import load_native_wal; \
+	          lib = load_native_wal(); \
+	          print('native wal:', 'ok' if lib else 'UNAVAILABLE')"
+
+# make test captures output like the reference (Makefile:10-15).
+test:
+	$(PY) -m pytest tests/ -q 2>&1 | tee test.out
+
+# Static analysis stand-in for `go vet`: compile every source file.
+vet:
+	$(PY) -m compileall -q raftsql_tpu tests bench.py __graft_entry__.py
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -f test.out raftsql_tpu/native/_native_*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

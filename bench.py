@@ -1,0 +1,295 @@
+"""Benchmark harness — the five BASELINE.json configs on one chip.
+
+Headline (default, what the driver records): committed log entries per
+second across N raft groups, using the fused whole-cluster step
+(core/cluster.py) — P peers x G groups advanced per device tick, proposals
+flowing at the flow-control limit, commits counted on device so only one
+scalar crosses the host boundary per timed run.
+
+The reference (chzchzchz/raftsql) publishes no numbers (BASELINE.md); the
+baseline used for `vs_baseline` is the driver-set north star of 1e8
+commits/sec (100k groups x 1k proposals/sec each, BASELINE.json).
+
+Prints exactly one JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Extra detail (per-config runs, latency estimate) goes to stderr.
+
+Environment knobs:
+  BENCH_CONFIG   headline | quorum | elections | commit_scan | multichip
+                 | all          (default headline)
+  BENCH_GROUPS / BENCH_PEERS / BENCH_TICKS / BENCH_REPEATS
+  BENCH_PLATFORM cpu|tpu        (override the captured jax platform)
+  BENCH_PROFILE  <dir>          (wrap timed runs in jax.profiler.trace)
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("BENCH_PLATFORM"):
+    # This environment's sitecustomize imports jax before us, so the
+    # JAX_PLATFORMS env var is already captured; update the live config.
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+import jax.numpy as jnp
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.core.cluster import (cluster_step, empty_cluster_inbox,
+                                      init_cluster_state)
+
+NORTH_STAR_COMMITS_PER_SEC = 1.0e8
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _profiled():
+    d = os.environ.get("BENCH_PROFILE")
+    return jax.profiler.trace(d) if d else contextlib.nullcontext()
+
+
+def make_bench_run(cfg: RaftConfig, num_ticks: int):
+    """Jitted: scan `num_ticks` cluster ticks; return (commit delta, mean
+    in-flight depth) — both device scalars.
+
+    Commit progress per group = max over peers of the commit index (every
+    peer converges to it; max is the entries durably quorum-committed).
+    The in-flight depth feeds Little's-law latency: W = L / lambda.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run(states, inboxes, prop_n):
+        commit0 = jnp.sum(jnp.max(states.commit, axis=0))
+
+        def body(carry, _):
+            st, ib = carry
+            st, ib, _ = cluster_step(cfg, st, ib, prop_n)
+            depth = jnp.mean((jnp.max(st.log_len, axis=0)
+                              - jnp.max(st.commit, axis=0)).astype(jnp.float32))
+            return (st, ib), depth
+
+        (states, inboxes), depths = jax.lax.scan(
+            body, (states, inboxes), None, length=num_ticks)
+        committed = jnp.sum(jnp.max(states.commit, axis=0)) - commit0
+        return states, inboxes, committed, jnp.mean(depths)
+
+    return run
+
+
+def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
+                     saturate: bool = True) -> float:
+    """Commits/sec for a G x P fused cluster under saturating load."""
+    cfg = RaftConfig(num_groups=groups, num_peers=peers, log_window=64,
+                     max_entries_per_msg=8, tick_interval_s=0.0)
+    states = init_cluster_state(cfg)
+    inboxes = empty_cluster_inbox(cfg)
+    load = cfg.max_entries_per_msg if saturate else 0
+    full = jnp.full((cfg.num_peers, cfg.num_groups), load, jnp.int32)
+
+    run = make_bench_run(cfg, ticks)
+    warm = make_bench_run(cfg, 4 * cfg.election_ticks)
+
+    # Warmup: elect leaders everywhere + trigger both compiles.
+    states, inboxes, _, _ = warm(states, inboxes, full * 0)
+    states, inboxes, c, _ = run(states, inboxes, full)
+    jax.block_until_ready(c)
+
+    best, best_lat = 0.0, float("inf")
+    total_committed = 0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        with _profiled():
+            states, inboxes, committed, depth = run(states, inboxes, full)
+            committed = int(jax.block_until_ready(committed))
+        dt = time.perf_counter() - t0
+        total_committed += committed
+        rate = committed / dt
+        # Little's law: mean propose->commit latency = depth / (per-group
+        # commit rate); depth is the mean uncommitted in-flight window.
+        lat_ms = (float(depth) * groups / rate * 1e3) if rate else 0.0
+        best = max(best, rate)
+        best_lat = min(best_lat, lat_ms)
+        _log(f"  {committed} commits in {dt:.3f}s -> {rate:,.0f} commits/s "
+             f"({rate / groups:,.1f}/group/s, est. mean latency "
+             f"{lat_ms:.2f} ms)")
+    if saturate and total_committed == 0:
+        raise RuntimeError("benchmark committed nothing — engine stalled")
+    return best
+
+
+def bench_elections(groups: int, peers: int, repeats: int) -> float:
+    """BASELINE config 3: randomized leader election at G x P.
+
+    Measures cold-start elections/sec: from a fresh (all-follower) state,
+    ticks until every group has a leader, repeated; value = groups elected
+    per second of device time.
+    """
+    cfg = RaftConfig(num_groups=groups, num_peers=peers, log_window=64,
+                     max_entries_per_msg=8, tick_interval_s=0.0)
+    T = 4 * cfg.election_ticks
+
+    @jax.jit
+    def elect(seed):
+        states = init_cluster_state(cfg, seed=0)
+        # Re-randomize timers per repeat by folding the seed into rng.
+        states = states._replace(tick=states.tick + seed)
+        inboxes = empty_cluster_inbox(cfg)
+        prop = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
+
+        def body(carry, _):
+            st, ib = carry
+            st, ib, _ = cluster_step(cfg, st, ib, prop)
+            return (st, ib), None
+
+        (states, _), _ = jax.lax.scan(body, (states, inboxes), None,
+                                      length=T)
+        return jnp.sum(jnp.any(states.role == 2, axis=0))
+
+    elected = int(elect(jnp.asarray(0, jnp.int32)))  # compile + check
+    best = 0.0
+    for r in range(repeats):
+        t0 = time.perf_counter()
+        elected = int(jax.block_until_ready(elect(jnp.asarray(r, jnp.int32))))
+        dt = time.perf_counter() - t0
+        _log(f"  elected {elected}/{groups} leaders in {dt:.3f}s "
+             f"({T} ticks) -> {elected / dt:,.0f} elections/s")
+        best = max(best, elected / dt)
+    return best
+
+
+def bench_commit_scan(groups: int, repeats: int) -> float:
+    """BASELINE config 4: the commit-index kernel alone at 100k groups.
+
+    Measures group-commit-scans/sec of `windowed_commit_index` (the full
+    masked prefix scan over the term ring) on random match/ring state.
+    """
+    from raftsql_tpu.ops.commit_scan import windowed_commit_index
+
+    W, P = 64, 5
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    log_len = jax.random.randint(ks[0], (groups,), 0, W, dtype=jnp.int32)
+    match = jnp.minimum(
+        jax.random.randint(ks[1], (groups, P), 0, W, dtype=jnp.int32),
+        log_len[:, None])
+    log_term = jax.random.randint(ks[2], (groups, W), 1, 4, dtype=jnp.int32)
+    commit = jnp.maximum(log_len - 8, 0)
+    term = jnp.full((groups,), 3, jnp.int32)
+    is_leader = jnp.ones((groups,), bool)
+
+    @jax.jit
+    def kernel(match, log_term, log_len, commit, term):
+        return windowed_commit_index(match, log_term, log_len, commit,
+                                     term, is_leader, quorum=3, window=W)
+
+    out = jax.block_until_ready(
+        kernel(match, log_term, log_len, commit, term))
+    iters = 50
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = kernel(match, log_term, log_len, commit, term)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rate = groups * iters / dt
+        _log(f"  {iters} x {groups}-group commit scans in {dt:.3f}s -> "
+             f"{rate:,.0f} scans/s")
+        best = max(best, rate)
+    return best
+
+
+def bench_multichip(ticks: int, repeats: int) -> float:
+    """BASELINE config 5: groups sharded over the device mesh, peer
+    message exchange riding `all_to_all` (parallel/sharded.py)."""
+    from raftsql_tpu.parallel.sharded import (make_mesh,
+                                              make_sharded_cluster_run,
+                                              shard_cluster_arrays)
+
+    n = len(jax.devices())
+    pp = 2 if n % 2 == 0 and n > 1 else 1
+    gg = n // pp
+    groups = int(os.environ.get("BENCH_GROUPS", 8192 * gg))
+    groups -= groups % gg
+    cfg = RaftConfig(num_groups=groups, num_peers=2 * pp if pp > 1 else 3,
+                     log_window=64, max_entries_per_msg=8,
+                     tick_interval_s=0.0)
+    mesh = make_mesh(pp, gg)
+    _log(f"  mesh {pp}x{gg} over {n} devices, {groups} groups x "
+         f"{cfg.num_peers} peers")
+    states = init_cluster_state(cfg)
+    inboxes = empty_cluster_inbox(cfg)
+    full = jnp.full((ticks, cfg.num_peers, cfg.num_groups),
+                    cfg.max_entries_per_msg, jnp.int32)
+    states, inboxes = shard_cluster_arrays(mesh, states, inboxes)
+
+    run = make_sharded_cluster_run(cfg, mesh, ticks)
+    states, inboxes, c = run(states, inboxes, full * 0)   # warmup/elect
+    states, inboxes, c = run(states, inboxes, full)
+    jax.block_until_ready(c)
+
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        states, inboxes, committed = run(states, inboxes, full)
+        committed = int(jax.block_until_ready(committed))
+        dt = time.perf_counter() - t0
+        _log(f"  {committed} commits in {dt:.3f}s -> "
+             f"{committed / dt:,.0f} commits/s")
+        best = max(best, committed / dt)
+    return best
+
+
+def main() -> None:
+    config = os.environ.get("BENCH_CONFIG", "headline")
+    groups = int(os.environ.get("BENCH_GROUPS", 100_000))
+    peers = int(os.environ.get("BENCH_PEERS", 3))
+    ticks = int(os.environ.get("BENCH_TICKS", 400))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    _log(f"bench[{config}]: platform={jax.devices()[0].platform} "
+         f"devices={len(jax.devices())}")
+
+    if config == "all":
+        results = {}
+        _log("== config 2: 1k x 3 quorum replication ==")
+        results["quorum_1k_x3"] = bench_throughput(1000, 3, ticks, repeats)
+        _log("== config 3: 10k x 5 elections ==")
+        results["elections_10k_x5"] = bench_elections(10_000, 5, repeats)
+        _log("== config 4: 100k-group commit scan ==")
+        results["commit_scan_100k"] = bench_commit_scan(100_000, repeats)
+        _log("== config 5: mesh-sharded cluster ==")
+        results["multichip"] = bench_multichip(ticks, repeats)
+        _log("== headline: G x P saturated throughput ==")
+        results["headline"] = bench_throughput(groups, peers, ticks, repeats)
+        for k, v in results.items():
+            _log(f"{k}: {v:,.0f}/s")
+        value = results["headline"]
+    elif config == "quorum":
+        value = bench_throughput(1000, 3, ticks, repeats)
+    elif config == "elections":
+        value = bench_elections(groups if groups != 100_000 else 10_000,
+                                5, repeats)
+    elif config == "commit_scan":
+        value = bench_commit_scan(groups, repeats)
+    elif config == "multichip":
+        value = bench_multichip(ticks, repeats)
+    else:
+        value = bench_throughput(groups, peers, ticks, repeats)
+
+    print(json.dumps({
+        "metric": "raft_commits_per_sec",
+        "value": round(value, 1),
+        "unit": "commits/s",
+        "vs_baseline": round(value / NORTH_STAR_COMMITS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
