@@ -116,6 +116,11 @@ class StepInfo(NamedTuple):
     app_n: jax.Array         # i32 number of entries written
     app_conflict: jax.Array  # bool append truncated conflicting suffix
     new_log_len: jax.Array   # i32 log length after the step
+    # Leader view [G, P]: where each peer's replication stands.  The host
+    # uses this to spot followers that have fallen out of the device term
+    # ring (next_idx <= log_len - W) and feed them catch-up appends built
+    # from the host payload log (runtime/node.py).
+    next_idx: jax.Array      # i32 [G, P] next index to send each peer
 
 
 def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,

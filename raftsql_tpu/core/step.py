@@ -328,7 +328,8 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         app_start=jnp.where(accept, prev + 1, 0),
         app_n=jnp.where(accept, a_n, 0),
         app_conflict=conflict,
-        new_log_len=log_len)
+        new_log_len=log_len,
+        next_idx=next_idx)
 
     return new_state, outbox, info
 

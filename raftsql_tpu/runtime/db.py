@@ -27,7 +27,9 @@ sentinel) to tests — the reference's `commitListenerC` observability hook
 """
 from __future__ import annotations
 
+import json
 import threading
+import time
 from collections import defaultdict, deque
 from typing import Callable, Dict, Optional, Tuple
 
@@ -35,6 +37,7 @@ from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
 from raftsql_tpu.runtime.node import CLOSED
 from raftsql_tpu.runtime.pipe import RaftPipe
+from raftsql_tpu.utils.metrics import LatencyTimer
 
 
 class AckFuture:
@@ -44,6 +47,7 @@ class AckFuture:
     def __init__(self):
         self._evt = threading.Event()
         self._err: Optional[Exception] = None
+        self.created = time.monotonic()
 
     def set(self, err: Optional[Exception]) -> None:
         self._err = err
@@ -68,6 +72,7 @@ class RaftDB:
         self._q2cb: Dict[Tuple[int, str], deque] = defaultdict(deque)
         self._failed: Optional[Exception] = None
         self._closed = False
+        self.latency = LatencyTimer()   # propose→ack, the p50 north star
 
         # Synchronous replay consumption (db.go:40): apply until the
         # sentinel so reads see the replayed state before we return.
@@ -102,6 +107,7 @@ class RaftDB:
                 if not cbs:
                     del self._q2cb[(group, query)]
             cb.set(err)
+            self.latency.record(time.monotonic() - cb.created)
 
         # Stream closed: clean shutdown or error teardown (db.go:83-95).
         err = self.pipe.error
@@ -162,10 +168,17 @@ class RaftDB:
         return self._sms[group].query(query)
 
     def metrics(self) -> dict:
-        return self.pipe.node.metrics.snapshot()
+        m = self.pipe.node.metrics.snapshot()
+        p50 = self.latency.percentile(0.5)
+        p99 = self.latency.percentile(0.99)
+        m["propose_commit_p50_ms"] = round(p50 * 1e3, 3) if p50 == p50 \
+            else None
+        m["propose_commit_p99_ms"] = round(p99 * 1e3, 3) if p99 == p99 \
+            else None
+        return m
 
     def render_metrics(self) -> str:
-        return self.pipe.node.metrics.render()
+        return json.dumps(self.metrics(), sort_keys=True) + "\n"
 
     def close(self) -> Optional[Exception]:
         """Shut down, failing (not leaking) any still-pending acks.

@@ -115,7 +115,8 @@ class RaftNode:
                 for g, gl in groups.items()}
         self.state = restore_peer_state(cfg, self.self_id, log_terms, hard)
         for g, gl in groups.items():
-            self.payload_log.put(g, 1, [d for (_, d) in gl.entries])
+            self.payload_log.put(g, 1, [d for (_, d) in gl.entries],
+                                 [t for (t, _) in gl.entries])
             self._hard_cache[g] = (gl.hard.term, gl.hard.vote,
                                    gl.hard.commit)
             # Reference parity: replay publishes every WAL entry, then the
@@ -324,14 +325,15 @@ class RaftNode:
                 base = int(info.prop_base[g])
                 if info.noop[g]:
                     put_rec(g, base, int(term[g]), b"")
-                    self.payload_log.put(g, base, [b""])
+                    self.payload_log.put(g, base, [b""], [int(term[g])])
                 if n_acc:
                     with self._prop_lock:
                         batch = [self._props[g].popleft()
                                  for _ in range(n_acc)]
                     for i, data in enumerate(batch):
                         put_rec(g, base + 1 + i, int(term[g]), data)
-                    self.payload_log.put(g, base + 1, batch)
+                    self.payload_log.put(g, base + 1, batch,
+                                         [int(term[g])] * n_acc)
                 self.metrics.proposals += n_acc
             src = int(info.app_from[g])
             if src >= 0:
@@ -344,7 +346,7 @@ class RaftNode:
                     put_rec(g, start + i, rec.ent_terms[i],
                             rec.payloads[i])
                 self.payload_log.put(g, start, rec.payloads,
-                                     new_len=new_len)
+                                     rec.ent_terms, new_len=new_len)
                 if info.app_conflict[g] and self._applied[g] >= start:
                     # Only possible for replay-published uncommitted
                     # entries (the reference applies at append and shares
@@ -366,12 +368,61 @@ class RaftNode:
             self.wal.set_hardstate(g, *hs)
         self.wal.sync()
 
+    def _build_catchups(self, info) -> Dict[Tuple[int, int], AppendRec]:
+        """Host-built AppendEntries for followers beyond the device ring.
+
+        The device term ring only describes the last W log positions; a
+        follower whose next_idx has fallen out of that window gets empty
+        heartbeats from the device (core/step.py Phase 9 window guard).
+        The leader HOST owns the full (term, payload) history
+        (storage/log.py), so it constructs the out-of-window appends here
+        — the analog of etcd MemoryStorage-backed sendAppend for entries
+        the in-memory window no longer covers.  Responses flow back
+        through the normal device path, advancing next_idx/match until
+        the follower re-enters the window.
+        """
+        cfg = self.cfg
+        W, E = cfg.log_window, cfg.max_entries_per_msg
+        role = np.asarray(info.role)
+        if not (role == LEADER).any():
+            return {}
+        next_idx = np.asarray(info.next_idx)            # [G, P]
+        log_len = np.asarray(info.new_log_len)          # [G]
+        commit = np.asarray(info.commit)
+        term = np.asarray(info.term)
+        # Margin of 2E: start host catch-up slightly before the hard edge
+        # of the ring so a race with concurrent appends cannot strand the
+        # follower on garbage ring reads.
+        lag = (role == LEADER)[:, None] & (next_idx >= 1) \
+            & (next_idx - 1 <= log_len[:, None] - W + 2 * E)
+        lag[:, self.self_id] = False
+        out: Dict[Tuple[int, int], AppendRec] = {}
+        for g, d in zip(*np.nonzero(lag)):
+            g, d = int(g), int(d)
+            ni = int(next_idx[g, d])
+            avail = self.payload_log.length(g)
+            n = min(E, avail - ni + 1)
+            if n <= 0:
+                continue
+            ents = self.payload_log.slice_with_terms(g, ni, n)
+            out[(g, d)] = AppendRec(
+                group=g, type=MSG_REQ, term=int(term[g]),
+                prev_idx=ni - 1,
+                prev_term=self.payload_log.term_of(g, ni - 1),
+                ent_terms=[t for (t, _) in ents],
+                payloads=[p for (_, p) in ents],
+                commit=min(int(commit[g]), ni - 1 + n))
+            self.metrics.catchup_appends += 1
+        return out
+
     def _send_phase(self, outbox, info) -> None:
         cfg = self.cfg
         batches: Dict[int, TickBatch] = {}
 
         def batch_for(dst0: int) -> TickBatch:
             return batches.setdefault(dst0, TickBatch())
+
+        catchups = self._build_catchups(info)
 
         vg, vd = np.nonzero(outbox.v_type)
         for g, d in zip(vg.tolist(), vd.tolist()):
@@ -384,6 +435,13 @@ class RaftNode:
         ag, ad = np.nonzero(outbox.a_type)
         for g, d in zip(ag.tolist(), ad.tolist()):
             mtype = int(outbox.a_type[g, d])
+            cu = catchups.pop((g, d), None) if mtype == MSG_REQ else None
+            if cu is not None:
+                # The device could only offer an empty heartbeat to this
+                # out-of-window follower; substitute the host-built
+                # catch-up append (same slot, newest-wins semantics).
+                batch_for(d).appends.append(cu)
+                continue
             n = int(outbox.a_n[g, d])
             prev = int(outbox.a_prev_idx[g, d])
             payloads = (self.payload_log.slice(g, prev + 1, n)
@@ -395,6 +453,8 @@ class RaftNode:
                 payloads=payloads, commit=int(outbox.a_commit[g, d]),
                 success=bool(outbox.a_success[g, d]),
                 match=int(outbox.a_match[g, d])))
+        for (g, d), cu in catchups.items():
+            batch_for(d).appends.append(cu)
 
         # Proposal forwarding: anything still queued while we are not the
         # leader goes to the leader hint, and is tracked for retry until

@@ -1,9 +1,17 @@
-"""Host-side payload log: entry bytes per (group, index).
+"""Host-side payload log: entry (term, bytes) per (group, index).
 
-The device log (core/state.py) stores only entry *terms*; the bytes of
-each proposal (SQL text) live here, mirroring device log positions 1:1.
-This splits the reference's `raft.MemoryStorage` (reference raft.go:129,
-229) into its two real roles: ordering metadata (device) and bytes (host).
+The device log (core/state.py) stores only the last-W entry *terms* in a
+ring; the bytes of each proposal (SQL text) — and the full term history,
+which the device ring forgets once an index slides out of the window —
+live here, mirroring device log positions 1:1.  This splits the
+reference's `raft.MemoryStorage` (reference raft.go:129, 229) into its two
+real roles: ordering metadata (device) and bytes (host).
+
+The full term history is what lets the leader's HOST build catch-up
+AppendEntries for followers that have fallen more than W entries behind —
+positions the device can no longer describe (runtime/node.py catch-up
+path; the reference gets the same from MemoryStorage.Term, which etcd's
+sendAppend consults before falling back to a snapshot).
 
 Like MemoryStorage, growth is unbounded and never compacted — a documented
 limitation shared with the reference; snapshots are the eventual fix for
@@ -11,45 +19,52 @@ both (reference db.go:27-29 declares the same).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional, Sequence, Tuple
 
 
 class PayloadLog:
-    """1-based, truncate-on-conflict byte log for G groups."""
+    """1-based, truncate-on-conflict (term, bytes) log for G groups."""
 
     def __init__(self, num_groups: int):
-        self._logs: List[List[bytes]] = [[] for _ in range(num_groups)]
+        self._logs: List[List[Tuple[int, bytes]]] = [
+            [] for _ in range(num_groups)]
 
     def length(self, group: int) -> int:
         return len(self._logs[group])
 
     def get(self, group: int, index: int) -> bytes:
-        return self._logs[group][index - 1]
+        return self._logs[group][index - 1][1]
+
+    def term_of(self, group: int, index: int) -> int:
+        """Term of entry `index`; term_of(0) == 0 (the log-start sentinel)."""
+        if index == 0:
+            return 0
+        return self._logs[group][index - 1][0]
 
     def slice(self, group: int, start: int, n: int) -> List[bytes]:
-        """Entries [start, start+n), 1-based."""
-        return self._logs[group][start - 1: start - 1 + n]
+        """Entry payloads [start, start+n), 1-based."""
+        return [d for (_, d) in self._logs[group][start - 1: start - 1 + n]]
 
-    def put(self, group: int, start: int, payloads: List[bytes],
-            new_len: Optional[int] = None) -> None:
-        """Write payloads at [start, start+len), extending/overwriting; then
-        truncate to new_len if given (the conflict-truncation mirror of the
-        device-side append in core/step.py Phase 4)."""
+    def slice_with_terms(self, group: int, start: int, n: int
+                         ) -> List[Tuple[int, bytes]]:
+        return list(self._logs[group][start - 1: start - 1 + n])
+
+    def put(self, group: int, start: int, payloads: Sequence[bytes],
+            terms: Sequence[int], new_len: Optional[int] = None) -> None:
+        """Write (term, payload) at [start, start+len), extending or
+        overwriting; then truncate to new_len if given (the
+        conflict-truncation mirror of the device-side append in
+        core/step.py Phase 4)."""
         log = self._logs[group]
-        for i, data in enumerate(payloads):
+        for i, (term, data) in enumerate(zip(terms, payloads)):
             pos = start - 1 + i
             if pos < len(log):
-                log[pos] = data
+                log[pos] = (term, data)
             elif pos == len(log):
-                log.append(data)
+                log.append((term, data))
             else:
                 raise ValueError(
                     f"payload gap: group {group} idx {pos + 1} > "
                     f"len {len(log)}")
         if new_len is not None and new_len < len(log):
             del log[new_len:]
-
-    def append(self, group: int, payloads: List[bytes]) -> int:
-        """Append at the tail; returns the new length."""
-        self._logs[group].extend(payloads)
-        return len(self._logs[group])

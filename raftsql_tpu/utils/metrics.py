@@ -7,7 +7,6 @@ the transport (reference raft.go:167-176) and never reads them; SURVEY.md
 """
 from __future__ import annotations
 
-import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -19,6 +18,7 @@ class NodeMetrics:
     proposals: int = 0
     commits: int = 0
     msgs_sent: int = 0
+    catchup_appends: int = 0
     started_at: float = field(default_factory=time.monotonic)
 
     def snapshot(self) -> dict:
@@ -28,26 +28,32 @@ class NodeMetrics:
             "proposals": self.proposals,
             "commits": self.commits,
             "msgs_sent": self.msgs_sent,
+            "catchup_appends": self.catchup_appends,
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
         }
 
-    def render(self) -> str:
-        return json.dumps(self.snapshot(), sort_keys=True) + "\n"
-
 
 class LatencyTimer:
-    """Thread-safe propose→commit latency sampler (p50 north-star metric)."""
+    """Thread-safe propose→commit latency sampler (p50 north-star metric).
+
+    A ring of the most recent `cap` samples, so percentiles track
+    steady-state latency instead of freezing on compile-stall-dominated
+    startup samples."""
 
     def __init__(self, cap: int = 4096):
         self._samples: list[float] = []
         self._cap = cap
+        self._next = 0
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
         with self._lock:
             if len(self._samples) < self._cap:
                 self._samples.append(seconds)
+            else:
+                self._samples[self._next] = seconds
+                self._next = (self._next + 1) % self._cap
 
     def percentile(self, q: float) -> float:
         with self._lock:
