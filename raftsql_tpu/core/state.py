@@ -158,18 +158,24 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
 
 def restore_peer_state(cfg: RaftConfig, self_id: int,
                        log_terms: dict, hard: dict,
-                       seed: int | None = None) -> PeerState:
+                       seed: int | None = None,
+                       starts: dict | None = None) -> PeerState:
     """Rebuild boot state from a replayed WAL (the reference's RestartNode
     path, raft.go:122-134, 161-163).
 
     Args:
-      log_terms: {group: [term of entry 1, term of entry 2, ...]}
+      log_terms: {group: [term of entry start+1, start+2, ...]}
       hard: {group: (term, voted_for, commit)}
+      starts: {group: (start, start_term)} — WAL-compaction floors; the
+        prefix up to `start` is snapshot-covered (committed + applied),
+        entries list begins at start+1.  The boundary term is seeded into
+        the ring so prev-term checks at the edge resolve on device.
     """
     import numpy as np
 
     st = init_peer_state(cfg, self_id, seed)
     g_, w = cfg.num_groups, cfg.log_window
+    starts = starts or {}
     term = np.zeros((g_,), np.int32)
     voted = np.full((g_,), NO_VOTE, np.int32)
     commit = np.zeros((g_,), np.int32)
@@ -178,15 +184,69 @@ def restore_peer_state(cfg: RaftConfig, self_id: int,
     for g in range(g_):
         t, v, c = hard.get(g, (0, NO_VOTE, 0))
         term[g], voted[g], commit[g] = t, v, c
+        start, start_term = starts.get(g, (0, 0))
         terms = log_terms.get(g, [])
-        log_len[g] = len(terms)
-        for idx in range(max(1, len(terms) - w + 1), len(terms) + 1):
-            window[g, (idx - 1) % w] = terms[idx - 1]
-        commit[g] = min(commit[g], log_len[g])
+        log_len[g] = start + len(terms)
+        lo = max(start + 1, log_len[g] - w + 1)
+        for idx in range(lo, log_len[g] + 1):
+            window[g, (idx - 1) % w] = terms[idx - 1 - start]
+        if start >= 1 and start > log_len[g] - w:
+            window[g, (start - 1) % w] = start_term
+        # The snapshot floor is committed by construction; hard.commit can
+        # trail it only if the marker postdates the last hardstate record.
+        commit[g] = min(max(commit[g], start), log_len[g])
     return st._replace(
         term=jnp.asarray(term), voted_for=jnp.asarray(voted),
         commit=jnp.asarray(commit), log_len=jnp.asarray(log_len),
         log_term=jnp.asarray(window))
+
+
+import functools
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnums=4)
+def install_snapshot_state(state: PeerState, g: jax.Array,
+                           last_idx: jax.Array, last_term: jax.Array,
+                           window: int) -> PeerState:
+    """Reset group `g`'s device row to a snapshot boundary.
+
+    The follower installed a state-machine image at log position
+    `last_idx` (entry term `last_term`): its log becomes exactly that
+    prefix — length and commit jump to last_idx, the term ring is cleared
+    except the boundary slot, and the row drops to follower so normal
+    replication resumes from last_idx + 1 (raft §7 InstallSnapshot; no
+    analog in the reference, which never snapshots, db.go:27-29).
+    """
+    g = jnp.asarray(g, I32)
+    last_idx = jnp.asarray(last_idx, I32)
+    ring = jnp.zeros((window,), I32).at[(last_idx - 1) % window].set(
+        jnp.asarray(last_term, I32))
+    return state._replace(
+        log_len=state.log_len.at[g].set(last_idx),
+        commit=state.commit.at[g].set(last_idx),
+        log_term=state.log_term.at[g].set(ring),
+        role=state.role.at[g].set(FOLLOWER),
+        votes=state.votes.at[g].set(False),
+        match=state.match.at[g].set(0),
+        next_idx=state.next_idx.at[g].set(last_idx + 1),
+        elapsed=state.elapsed.at[g].set(0),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def set_peer_progress(state: PeerState, g: jax.Array, d: jax.Array,
+                      next_idx: jax.Array) -> PeerState:
+    """Leader-side optimistic advance after shipping a snapshot to peer
+    `d`: replication resumes at next_idx = last_idx + 1.  `match` is NOT
+    touched: the step clamps next_idx to match+1 from below, so a match
+    the peer never acknowledged would block reject-walkback permanently —
+    a snapshot sent to a dead peer would strand it.  If the transfer is
+    lost, the peer's rejects walk next_idx back and retrigger it; if it
+    lands, the next real append's ack advances match."""
+    g = jnp.asarray(g, I32)
+    d = jnp.asarray(d, I32)
+    return state._replace(
+        next_idx=state.next_idx.at[g, d].set(jnp.asarray(next_idx, I32)))
 
 
 def empty_inbox(cfg: RaftConfig) -> Inbox:

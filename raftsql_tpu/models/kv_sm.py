@@ -17,10 +17,16 @@ class KVStateMachine:
     def __init__(self, path: str = ""):
         self._data: Dict[str, str] = {}
         self._lock = threading.Lock()
+        self._applied = 0   # volatile — KV has no durable snapshot
 
-    def apply(self, command: str) -> Optional[Exception]:
+    def applied_index(self) -> int:
+        return self._applied
+
+    def apply(self, command: str, index: int = 0) -> Optional[Exception]:
         parts = command.split(" ", 2)
         with self._lock:
+            if index and index <= self._applied:
+                return None     # already covered (e.g. by an install)
             try:
                 if parts[0] == "SET" and len(parts) == 3:
                     self._data[parts[1]] = parts[2]
@@ -31,6 +37,9 @@ class KVStateMachine:
                 return None
             except Exception as e:     # pragma: no cover - defensive
                 return e
+            finally:
+                if index:
+                    self._applied = index
 
     def query(self, q: str) -> str:
         parts = q.split(" ", 1)
@@ -44,6 +53,22 @@ class KVStateMachine:
     def snapshot(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._data)
+
+    def serialize(self) -> bytes:
+        import json
+        with self._lock:
+            return json.dumps(self._data).encode()
+
+    def serialize_with_index(self):
+        import json
+        with self._lock:
+            return self._applied, json.dumps(self._data).encode()
+
+    def install(self, blob: bytes, index: int) -> None:
+        import json
+        with self._lock:
+            self._data = json.loads(blob.decode())
+            self._applied = index
 
     def close(self) -> None:
         pass

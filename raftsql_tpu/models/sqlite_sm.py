@@ -39,22 +39,106 @@ def _cell(v) -> str:
 
 
 class SQLiteStateMachine:
-    def __init__(self, path: str):
-        # Rebuilt from the log on every boot (reference db.go:29).
-        if path != ":memory:" and os.path.exists(path):
+    """`resume=False` (default): reference parity — the DB file is deleted
+    on boot and rebuilt from the log (db.go:29).
+
+    `resume=True`: the DB file IS the snapshot.  Every apply writes the
+    entry's log index into the `_raft_meta` table inside the SAME SQLite
+    transaction as the command, so file-state and applied-index are
+    crash-atomic; on reboot the engine skips entries at or below
+    `applied_index()` instead of replaying from scratch."""
+
+    def __init__(self, path: str, resume: bool = False):
+        if not resume and path != ":memory:" and os.path.exists(path):
             os.remove(path)
         self.path = path
+        self.resume = resume
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
+        self._applied = 0
+        if resume:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS _raft_meta "
+                "(k TEXT PRIMARY KEY, v INTEGER)")
+            self._conn.commit()
+            row = self._conn.execute(
+                "SELECT v FROM _raft_meta WHERE k='applied_index'"
+            ).fetchone()
+            self._applied = int(row[0]) if row else 0
 
-    def apply(self, command: str) -> Optional[Exception]:
+    def applied_index(self) -> int:
+        return self._applied
+
+    def apply(self, command: str, index: int = 0) -> Optional[Exception]:
         with self._lock:
+            # The authoritative exactly-once check lives under the SAME
+            # lock install() takes: a snapshot install racing the applier
+            # thread bumps _applied before this runs, so a stale queued
+            # entry can never re-apply over the installed image.
+            if self.resume and index and index <= self._applied:
+                return None
             try:
                 self._conn.execute(command)
+                if self.resume and index:
+                    # Same transaction as the command: crash-atomic
+                    # exactly-once apply.
+                    self._conn.execute(
+                        "INSERT INTO _raft_meta (k, v) VALUES "
+                        "('applied_index', ?) ON CONFLICT(k) DO UPDATE "
+                        "SET v=excluded.v", (index,))
                 self._conn.commit()
+                if index:
+                    self._applied = index
                 return None
             except sqlite3.Error as e:
+                # A failed command still advances the applied index (the
+                # entry was consumed, its error is its outcome) — roll
+                # back its effects, then record the index alone.  The
+                # recovery writes get their own guard: if they too fail
+                # (disk full), the ORIGINAL error must still be returned
+                # rather than escaping and killing the applier thread.
+                try:
+                    self._conn.rollback()
+                    if self.resume and index:
+                        self._conn.execute(
+                            "INSERT INTO _raft_meta (k, v) VALUES "
+                            "('applied_index', ?) ON CONFLICT(k) DO "
+                            "UPDATE SET v=excluded.v", (index,))
+                        self._conn.commit()
+                    if index:
+                        self._applied = index
+                except sqlite3.Error:
+                    pass
                 return e
+
+    def serialize(self) -> bytes:
+        """Consistent point-in-time image of the database (the blob of an
+        InstallSnapshot transfer)."""
+        with self._lock:
+            return self._conn.serialize()
+
+    def serialize_with_index(self):
+        """(applied_index, image) captured atomically — the pair an
+        InstallSnapshot sender needs (an apply sneaking between the two
+        reads would mislabel the image's log position)."""
+        with self._lock:
+            return self._applied, self._conn.serialize()
+
+    def install(self, blob: bytes, index: int) -> None:
+        """Replace all state with a serialized image applied up to
+        `index` (receiver side of InstallSnapshot)."""
+        with self._lock:
+            self._conn.deserialize(blob)
+            if self.resume:
+                self._conn.execute(
+                    "CREATE TABLE IF NOT EXISTS _raft_meta "
+                    "(k TEXT PRIMARY KEY, v INTEGER)")
+                self._conn.execute(
+                    "INSERT INTO _raft_meta (k, v) VALUES "
+                    "('applied_index', ?) ON CONFLICT(k) DO UPDATE "
+                    "SET v=excluded.v", (index,))
+                self._conn.commit()
+            self._applied = index
 
     def query(self, q: str) -> str:
         with self._lock:

@@ -132,6 +132,20 @@ int wal_append_entries(void* h, uint32_t n, const uint32_t* groups,
   return 0;
 }
 
+int wal_set_snapshot(void* h, uint32_t group, uint64_t index,
+                     uint64_t term) {
+  Wal* w = static_cast<Wal*>(h);
+  std::vector<uint8_t> body;
+  body.reserve(21);
+  body.push_back(3);
+  put_u32(body, group);
+  put_u64(body, index);
+  put_u64(body, term);
+  std::lock_guard<std::mutex> lk(w->mu);
+  frame(w, body);
+  return 0;
+}
+
 int wal_set_hardstate(void* h, uint32_t group, uint64_t term, int64_t vote,
                       uint64_t commit) {
   Wal* w = static_cast<Wal*>(h);

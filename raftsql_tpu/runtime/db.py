@@ -62,12 +62,31 @@ class AckFuture:
 class RaftDB:
     def __init__(self, sm_factory: Callable[[int], StateMachine],
                  pipe: RaftPipe, num_groups: int = 1,
-                 listener=None):
+                 listener=None, resume: bool = False,
+                 compact_every: int = 0, compact_keep: int = 1024):
+        """resume=True enables snapshot-resume (SURVEY.md §5.4
+        improvement): state machines that persist applied_index (see
+        SQLiteStateMachine resume mode) skip re-apply of already-applied
+        replayed entries, and — when compact_every > 0 — the WAL prefix
+        covered by every group's snapshot is compacted away after every
+        `compact_every` applies (retaining `compact_keep` entries for
+        follower catch-up).  Default off: reference delete-and-replay
+        parity (db.go:27-29)."""
         self.pipe = pipe
         self.num_groups = num_groups
         self.listener = listener            # queue-like or None
+        self.resume = resume
+        self._compact_every = compact_every if resume else 0
+        self._compact_keep = compact_keep
+        self._applies_since_compact = 0
         self._sms: Dict[int, StateMachine] = {
             g: sm_factory(g) for g in range(num_groups)}
+        if resume:
+            # Full state transfer for followers beyond the compaction
+            # floor (InstallSnapshot) is only sound when re-apply is
+            # snapshot-aware, so it rides the resume flag.
+            pipe.node.snapshot_provider = self._snapshot_of
+            pipe.node.snapshot_installer = self._install_snapshot
         self._mu = threading.Lock()
         self._q2cb: Dict[Tuple[int, str], deque] = defaultdict(deque)
         self._failed: Optional[Exception] = None
@@ -95,8 +114,14 @@ class RaftDB:
                 continue
             if item is CLOSED:
                 break
-            group, query = item
-            err = self._sms[group].apply(query)
+            group, index, query = item
+            sm = self._sms[group]
+            # In resume mode the state machine itself skips entries at or
+            # below its durable applied index (atomically under its own
+            # lock, racing snapshot installs safely) and returns None —
+            # so skipped-but-committed entries still resolve their acks.
+            err = sm.apply(query, index)
+            self._maybe_compact()
             if self.listener is not None:
                 self.listener.put((group, query))
             with self._mu:
@@ -120,6 +145,28 @@ class RaftDB:
                 cb.set(err)
 
     # ------------------------------------------------------------------
+
+    def _snapshot_of(self, group: int):
+        sm = self._sms[group]
+        fn = getattr(sm, "serialize_with_index", None)
+        if fn is None:
+            return None
+        idx, blob = fn()
+        return (idx, blob) if idx > 0 else None
+
+    def _install_snapshot(self, group: int, index: int,
+                          blob: bytes) -> None:
+        self._sms[group].install(blob, index)
+
+    def _maybe_compact(self) -> None:
+        if not self._compact_every:
+            return
+        self._applies_since_compact += 1
+        if self._applies_since_compact < self._compact_every:
+            return
+        self._applies_since_compact = 0
+        applied = {g: sm.applied_index() for g, sm in self._sms.items()}
+        self.pipe.node.compact(applied, keep=self._compact_keep)
 
     def propose(self, query: str, group: int = 0) -> AckFuture:
         """Submit a write; the future resolves after commit + local apply

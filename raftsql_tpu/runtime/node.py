@@ -40,13 +40,14 @@ import jax.numpy as jnp
 
 from raftsql_tpu.config import LEADER, MSG_REQ, MSG_RESP, NO_VOTE, RaftConfig
 from raftsql_tpu.core.state import (Inbox, init_peer_state,
-                                    restore_peer_state)
+                                    install_snapshot_state,
+                                    restore_peer_state, set_peer_progress)
 from raftsql_tpu.core.step import peer_step_jit
 from raftsql_tpu.runtime.envelope import DedupWindow, unwrap, wrap
 from raftsql_tpu.storage.log import PayloadLog
 from raftsql_tpu.storage.wal import WAL, wal_exists
-from raftsql_tpu.transport.base import (AppendRec, ProposalRec, TickBatch,
-                                        Transport, VoteRec)
+from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
+                                        TickBatch, Transport, VoteRec)
 from raftsql_tpu.utils.metrics import NodeMetrics
 
 log = logging.getLogger("raftsql_tpu.node")
@@ -82,6 +83,16 @@ class RaftNode:
         self._stage_lock = threading.Lock()
         self._stage_votes: Dict[Tuple[int, int], VoteRec] = {}
         self._stage_apps: Dict[Tuple[int, int], AppendRec] = {}
+        self._stage_snaps: Dict[int, SnapshotRec] = {}
+
+        # InstallSnapshot hooks (wired by the apply layer in resume mode;
+        # both unset => full state transfer disabled, catch-up below the
+        # compaction floor just logs).  provider(g) -> (applied_idx, blob);
+        # installer(g, last_idx, blob) replaces the state machine's state.
+        self.snapshot_provider = None
+        self.snapshot_installer = None
+        self._snap_sent: Dict[Tuple[int, int], int] = {}
+        self._snap_due: List[Tuple[int, int, int]] = []
 
         self._prop_lock = threading.Lock()
         self._props: List[deque] = [deque() for _ in range(G)]
@@ -105,6 +116,8 @@ class RaftNode:
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_apps: Dict[Tuple[int, int], AppendRec] = {}
+        # Serializes the tick's WAL phase against compaction rewrites.
+        self._wal_lock = threading.Lock()
 
         # ---- replay (reference raft.go:122-134 + db.go:27-29 contract).
         self._had_wal = wal_exists(data_dir)
@@ -113,9 +126,14 @@ class RaftNode:
                      for g, gl in groups.items()}
         hard = {g: (gl.hard.term, gl.hard.vote, gl.hard.commit)
                 for g, gl in groups.items()}
-        self.state = restore_peer_state(cfg, self.self_id, log_terms, hard)
+        starts = {g: (gl.start, gl.start_term) for g, gl in groups.items()}
+        self.state = restore_peer_state(cfg, self.self_id, log_terms, hard,
+                                        starts=starts)
         for g, gl in groups.items():
-            self.payload_log.put(g, 1, [d for (_, d) in gl.entries],
+            if gl.start:
+                self.payload_log.set_start(g, gl.start, gl.start_term)
+            self.payload_log.put(g, gl.start + 1,
+                                 [d for (_, d) in gl.entries],
                                  [t for (t, _) in gl.entries])
             self._hard_cache[g] = (gl.hard.term, gl.hard.vote,
                                    gl.hard.commit)
@@ -133,10 +151,10 @@ class RaftNode:
 
     def start(self) -> None:
         for g, gl in sorted(self._replay_groups.items()):
-            for (term, data) in gl.entries:
+            for i, (term, data) in enumerate(gl.entries):
                 sql = self._decode_entry(g, data)
                 if sql is not None:
-                    self.commit_q.put((g, sql))
+                    self.commit_q.put((g, gl.start + 1 + i, sql))
         self._replay_groups = {}
         self.commit_q.put(None)         # replay-complete sentinel
         self.transport.start(self.node_id, self._deliver, self._on_error)
@@ -192,6 +210,55 @@ class RaftNode:
         return int(np.asarray(self.state.leader_hint)[group])
 
     # ------------------------------------------------------------------
+    # log compaction (snapshot-resume mode, SURVEY.md §5.4 improvement)
+
+    def compact(self, applied: Dict[int, int], keep: int = 256) -> bool:
+        """Drop log prefixes covered by state-machine snapshots.
+
+        `applied[g]` is the index durably applied by the snapshot-capable
+        state machine.  Entries up to min(applied, commit) - keep are
+        dropped from the payload log, and the WAL is atomically rewritten
+        to {snapshot marker, retained tail, hard state} per group.  The
+        retained `keep` window lets slow followers catch up from the
+        payload log (runtime catch-up path); a follower lagging beyond it
+        needs a full state transfer, which is not yet implemented — hence
+        the generous default; beyond it, the leader ships a full state
+        transfer (InstallSnapshot, _send_phase).
+
+        Returns True if anything was compacted.
+        """
+        from raftsql_tpu.storage.wal import GroupLog, HardState
+
+        # Never compact into the device ring window: the ordinary send
+        # path slices payloads for any in-window prev index.
+        keep = max(keep, self.cfg.log_window)
+        with self._wal_lock:
+            changed = False
+            image: Dict[int, GroupLog] = {}
+            for g in range(self.cfg.num_groups):
+                term, vote, commit = self._hard_cache.get(g, (0, -1, 0))
+                floor = min(applied.get(g, 0), commit,
+                            self._applied[g]) - keep
+                if floor > self.payload_log.start(g):
+                    self.payload_log.compact(
+                        g, floor, self.payload_log.term_of(g, floor))
+                    changed = True
+                s = self.payload_log.start(g)
+                n = self.payload_log.length(g) - s
+                image[g] = GroupLog(
+                    hard=HardState(term=term, vote=vote, commit=commit),
+                    entries=self.payload_log.slice_with_terms(g, s + 1, n),
+                    start=s,
+                    start_term=self.payload_log.term_of(g, s) if s else 0)
+            if not changed:
+                return False
+            self.wal.close()
+            WAL.rewrite(self.data_dir, image)
+            self.wal = WAL(self.data_dir)
+            self.metrics.compactions += 1
+            return True
+
+    # ------------------------------------------------------------------
     # transport plane
 
     def _deliver(self, src: int, batch: TickBatch) -> None:
@@ -216,6 +283,11 @@ class RaftNode:
                 if 0 <= a.group < G and a.n <= E \
                         and len(a.payloads) in (0, a.n):
                     self._stage_apps[(a.group, src0)] = a
+            for s in batch.snapshots:
+                if 0 <= s.group < G:
+                    old = self._stage_snaps.get(s.group)
+                    if old is None or s.last_idx > old.last_idx:
+                        self._stage_snaps[s.group] = s
         if batch.proposals:
             with self._prop_lock:
                 for pr in batch.proposals:
@@ -244,6 +316,7 @@ class RaftNode:
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
 
+        self._install_snapshots()
         inbox, tick_apps = self._build_inbox()
         self._tick_apps = tick_apps
 
@@ -256,13 +329,60 @@ class RaftNode:
         self.state = state
         outbox, info = jax.device_get((outbox, info))
 
-        self._wal_phase(info)           # durable …
+        with self._wal_lock:
+            self._wal_phase(info)       # durable …
         self._send_phase(outbox, info)  # … before sent …
         self._publish_phase(info)       # … before published.
         self._tick_no += 1
         self.metrics.ticks += 1
 
     # -- tick phases -----------------------------------------------------
+
+    def _install_snapshots(self) -> None:
+        """Apply staged InstallSnapshot transfers (receiver side).
+
+        Only installs strictly ahead of both the local applied point and
+        the device commit — snapshots carry committed state, so this
+        never regresses; stale/duplicate transfers are dropped.
+        """
+        if self.snapshot_installer is None:
+            # The apply layer registers the installer shortly after node
+            # start; keep transfers staged instead of dropping them so a
+            # snapshot arriving in that boot window still installs.
+            return
+        with self._stage_lock:
+            snaps, self._stage_snaps = self._stage_snaps, {}
+        if not snaps:
+            return
+        commit = None
+        for g, rec in snaps.items():
+            if commit is None:
+                commit = np.asarray(self.state.commit)
+            if rec.last_idx <= max(self._applied[g], int(commit[g])):
+                continue
+            try:
+                self.snapshot_installer(g, rec.last_idx, rec.blob)
+            except Exception as e:
+                # A corrupt/truncated transfer must not tear down the
+                # node (cf. the _deliver contract); drop it — the leader
+                # re-sends after its cooldown.
+                log.warning("node %d g%d: snapshot install failed (%s); "
+                            "dropped", self.node_id, g, e)
+                continue
+            # Counted at SM-install time: observers (tests, operators)
+            # see the data the moment the state machine has it, while the
+            # device-state patch below may still be compiling.
+            self.metrics.snapshots_installed += 1
+            self.payload_log.reset(g, rec.last_idx, rec.last_term)
+            with self._wal_lock:
+                self.wal.set_snapshot(g, rec.last_idx, rec.last_term)
+                self.wal.sync()
+            self.state = install_snapshot_state(
+                self.state, g, rec.last_idx, rec.last_term,
+                self.cfg.log_window)
+            self._applied[g] = rec.last_idx
+            log.info("node %d g%d: installed snapshot at idx %d",
+                     self.node_id, g, rec.last_idx)
 
     def _build_inbox(self):
         cfg = self.cfg
@@ -383,6 +503,7 @@ class RaftNode:
         """
         cfg = self.cfg
         W, E = cfg.log_window, cfg.max_entries_per_msg
+        self._snap_due = []
         role = np.asarray(info.role)
         if not (role == LEADER).any():
             return {}
@@ -402,16 +523,21 @@ class RaftNode:
             ni = int(next_idx[g, d])
             avail = self.payload_log.length(g)
             n = min(E, avail - ni + 1)
-            if n <= 0:
+            got = self.payload_log.try_tail_with_terms(g, ni, n) \
+                if n > 0 else None
+            if got is None:
+                if ni <= self.payload_log.start(g):
+                    # Beyond the compacted prefix: needs a full state
+                    # transfer (InstallSnapshot), queued by _send_phase.
+                    self._snap_due.append((g, d, int(term[g])))
                 continue
-            ents = self.payload_log.slice_with_terms(g, ni, n)
+            prev_term, ents = got
             out[(g, d)] = AppendRec(
                 group=g, type=MSG_REQ, term=int(term[g]),
-                prev_idx=ni - 1,
-                prev_term=self.payload_log.term_of(g, ni - 1),
+                prev_idx=ni - 1, prev_term=prev_term,
                 ent_terms=[t for (t, _) in ents],
                 payloads=[p for (_, p) in ents],
-                commit=min(int(commit[g]), ni - 1 + n))
+                commit=min(int(commit[g]), ni - 1 + len(ents)))
             self.metrics.catchup_appends += 1
         return out
 
@@ -444,8 +570,18 @@ class RaftNode:
                 continue
             n = int(outbox.a_n[g, d])
             prev = int(outbox.a_prev_idx[g, d])
-            payloads = (self.payload_log.slice(g, prev + 1, n)
-                        if mtype == MSG_REQ else [])
+            if mtype == MSG_REQ:
+                # The device ring can reference positions below the
+                # payload floor (log-length regression after conflict
+                # truncation / snapshot install, or a concurrent
+                # compaction advancing the floor).  try_slice is atomic
+                # against the compactor; on miss, drop the message — the
+                # peer is served by catch-up or snapshot on a later tick.
+                payloads = self.payload_log.try_slice(g, prev + 1, n)
+                if payloads is None:
+                    continue
+            else:
+                payloads = []
             batch_for(d).appends.append(AppendRec(
                 group=g, type=mtype, term=int(outbox.a_term[g, d]),
                 prev_idx=prev, prev_term=int(outbox.a_prev_term[g, d]),
@@ -455,6 +591,37 @@ class RaftNode:
                 match=int(outbox.a_match[g, d])))
         for (g, d), cu in catchups.items():
             batch_for(d).appends.append(cu)
+
+        # InstallSnapshot dispatch (rate-limited: transfers are bulky and
+        # idempotent, a cooldown per (group, peer) is plenty).
+        if self._snap_due and self.snapshot_provider is not None:
+            cooldown = 8 * cfg.election_ticks
+            for g, d, term_g in self._snap_due:
+                last = self._snap_sent.get((g, d), -cooldown)
+                if self._tick_no - last < cooldown:
+                    continue
+                got = self.snapshot_provider(g)
+                if got is None:
+                    continue
+                last_idx, blob = got
+                if last_idx <= self.payload_log.start(g) \
+                        and last_idx < self.payload_log.length(g):
+                    # The snapshot doesn't reach the floor the follower
+                    # needs (applier lagging behind compaction — cannot
+                    # happen through the RaftDB path, which compacts only
+                    # below its own applied index); don't send garbage.
+                    continue
+                self._snap_sent[(g, d)] = self._tick_no
+                batch_for(d).snapshots.append(SnapshotRec(
+                    group=g, last_idx=last_idx,
+                    last_term=self.payload_log.term_of(g, last_idx),
+                    term=term_g, blob=blob))
+                # Resume replication above the transfer; see
+                # set_peer_progress for why this is safe if it is lost.
+                self.state = set_peer_progress(
+                    self.state, g, d, last_idx + 1)
+                self.metrics.snapshots_sent += 1
+        self._snap_due = []
 
         # Proposal forwarding: anything still queued while we are not the
         # leader goes to the leader hint, and is tracked for retry until
@@ -484,7 +651,8 @@ class RaftNode:
             self.transport.send(dst0 + 1, batch)
             self.metrics.msgs_sent += (len(batch.votes)
                                        + len(batch.appends)
-                                       + len(batch.proposals))
+                                       + len(batch.proposals)
+                                       + len(batch.snapshots))
 
     def _publish_phase(self, info) -> None:
         for g in range(self.cfg.num_groups):
@@ -501,6 +669,6 @@ class RaftNode:
                             break
                 sql = self._decode_entry(g, data)
                 if sql is not None:
-                    self.commit_q.put((g, sql))
+                    self.commit_q.put((g, idx, sql))
                 self._applied[g] += 1
                 self.metrics.commits += 1

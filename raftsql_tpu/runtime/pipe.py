@@ -20,7 +20,7 @@ from raftsql_tpu.runtime.node import RaftNode
 class RaftPipe:
     def __init__(self, node: RaftNode):
         self.node = node
-        self.commit_q = node.commit_q     # (group, sql) | None | CLOSED
+        self.commit_q = node.commit_q   # (group, index, sql)|None|CLOSED
 
     @classmethod
     def create(cls, node_id: int, num_nodes: int, cfg, transport,

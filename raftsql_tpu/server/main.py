@@ -28,7 +28,8 @@ from raftsql_tpu.transport.tcp import TcpTransport
 
 def build_node(cluster: str, node_id: int, groups: int = 1,
                tick: float = 0.01, election_ticks: int = 10,
-               data_prefix: str = "raftsql") -> RaftDB:
+               data_prefix: str = "raftsql", resume: bool = False,
+               compact_every: int = 0) -> RaftDB:
     peers = cluster.split(",")
     cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
                      tick_interval_s=tick, election_ticks=election_ticks)
@@ -39,9 +40,10 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
     def sm_factory(g: int) -> SQLiteStateMachine:
         path = (f"{data_prefix}-{node_id}.db" if g == 0
                 else f"{data_prefix}-{node_id}-g{g}.db")
-        return SQLiteStateMachine(path)
+        return SQLiteStateMachine(path, resume=resume)
 
-    return RaftDB(sm_factory, pipe, num_groups=groups)
+    return RaftDB(sm_factory, pipe, num_groups=groups, resume=resume,
+                  compact_every=compact_every)
 
 
 def main(argv=None) -> None:
@@ -55,6 +57,13 @@ def main(argv=None) -> None:
                     help="number of raft groups")
     ap.add_argument("--tick", type=float, default=0.01,
                     help="seconds per consensus tick")
+    ap.add_argument("--resume", action="store_true",
+                    help="snapshot-resume: keep the SQLite file across "
+                         "restarts and skip re-applying the replayed "
+                         "prefix (default: reference delete-and-replay)")
+    ap.add_argument("--compact-every", type=int, default=0,
+                    help="with --resume: rewrite the WAL dropping "
+                         "snapshot-covered prefixes every N applies")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -62,7 +71,8 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     rdb = build_node(args.cluster, args.id, groups=args.groups,
-                     tick=args.tick)
+                     tick=args.tick, resume=args.resume,
+                     compact_every=args.compact_every)
     serve_http_sql_api(args.port, rdb)
 
 

@@ -23,31 +23,116 @@ from typing import List, Optional, Sequence, Tuple
 
 
 class PayloadLog:
-    """1-based, truncate-on-conflict (term, bytes) log for G groups."""
+    """1-based, truncate-on-conflict (term, bytes) log for G groups.
+
+    After `compact(g, upto, term)`, entries at or below `upto` are
+    dropped; `start(g)` reports the floor and `term_of(g, start)` still
+    resolves (the boundary term is retained) so AppendEntries prev-term
+    checks at the compaction edge work."""
 
     def __init__(self, num_groups: int):
         self._logs: List[List[Tuple[int, bytes]]] = [
             [] for _ in range(num_groups)]
+        self._start: List[int] = [0] * num_groups
+        self._start_term: List[int] = [0] * num_groups
+        # One lock: readers (publish, catch-up, send) race the compactor,
+        # and a torn (_start, _logs) read would mis-align indexes.
+        self._mu = __import__("threading").RLock()
 
     def length(self, group: int) -> int:
-        return len(self._logs[group])
+        with self._mu:
+            return self._start[group] + len(self._logs[group])
+
+    def start(self, group: int) -> int:
+        with self._mu:
+            return self._start[group]
+
+    def set_start(self, group: int, start: int, start_term: int) -> None:
+        """Initialize the compaction floor on restart (from a WAL
+        snapshot marker).  Only valid on an empty group log."""
+        with self._mu:
+            assert not self._logs[group]
+            self._start[group] = start
+            self._start_term[group] = start_term
+
+    def reset(self, group: int, start: int, start_term: int) -> None:
+        """Discard the group's entire log and restart it at `start` (the
+        receiver side of InstallSnapshot: history before the snapshot is
+        gone, and any suffix predating it may conflict)."""
+        with self._mu:
+            self._logs[group].clear()
+            self._start[group] = start
+            self._start_term[group] = start_term
+
+    def compact(self, group: int, upto: int, boundary_term: int) -> None:
+        """Drop entries <= upto (must be <= length)."""
+        with self._mu:
+            s = self._start[group]
+            if upto <= s:
+                return
+            del self._logs[group][: upto - s]
+            self._start[group] = upto
+            self._start_term[group] = boundary_term
 
     def get(self, group: int, index: int) -> bytes:
-        return self._logs[group][index - 1][1]
+        with self._mu:
+            return self._logs[group][index - 1 - self._start[group]][1]
 
     def term_of(self, group: int, index: int) -> int:
-        """Term of entry `index`; term_of(0) == 0 (the log-start sentinel)."""
-        if index == 0:
-            return 0
-        return self._logs[group][index - 1][0]
+        """Term of entry `index`; term_of(0) == 0 (the log-start
+        sentinel), term_of(start) == the retained boundary term."""
+        with self._mu:
+            if index == 0:
+                return 0
+            s = self._start[group]
+            if index == s:
+                return self._start_term[group]
+            # A negative list index would silently wrap to the tail.
+            assert index > s, f"term_of below compaction floor ({index})"
+            return self._logs[group][index - 1 - s][0]
+
+    def try_tail_with_terms(self, group: int, start: int, n: int):
+        """Atomic (prev_term, [(term, payload)...]) for entries
+        [start, start+n) — None if `start` has been compacted away.
+        The single lock hold makes check + boundary-term + slice one
+        consistent read against the concurrent compactor."""
+        with self._mu:
+            s0 = self._start[group]
+            if start <= s0:
+                return None
+            if start - 1 == 0:
+                prev_term = 0
+            elif start - 1 == s0:
+                prev_term = self._start_term[group]
+            else:
+                prev_term = self._logs[group][start - 2 - s0][0]
+            rel = start - 1 - s0
+            return prev_term, list(self._logs[group][rel: rel + n])
 
     def slice(self, group: int, start: int, n: int) -> List[bytes]:
         """Entry payloads [start, start+n), 1-based."""
-        return [d for (_, d) in self._logs[group][start - 1: start - 1 + n]]
+        with self._mu:
+            s = start - 1 - self._start[group]
+            assert s >= 0, "slice below compaction floor"
+            return [d for (_, d) in self._logs[group][s: s + n]]
+
+    def try_slice(self, group: int, start: int, n: int
+                  ) -> Optional[List[bytes]]:
+        """Like slice, but None when [start, start+n) dips below the
+        compaction floor — the floor moves concurrently (compactor
+        thread), so check-then-slice must be one atomic operation."""
+        with self._mu:
+            s = start - 1 - self._start[group]
+            if s < 0:
+                return None
+            return [d for (_, d) in self._logs[group][s: s + n]]
 
     def slice_with_terms(self, group: int, start: int, n: int
                          ) -> List[Tuple[int, bytes]]:
-        return list(self._logs[group][start - 1: start - 1 + n])
+        with self._mu:
+            s = start - 1 - self._start[group]
+            assert s >= 0, "slice below compaction floor"
+            return list(self._logs[group][s: s + n])
 
     def put(self, group: int, start: int, payloads: Sequence[bytes],
             terms: Sequence[int], new_len: Optional[int] = None) -> None:
@@ -55,16 +140,20 @@ class PayloadLog:
         overwriting; then truncate to new_len if given (the
         conflict-truncation mirror of the device-side append in
         core/step.py Phase 4)."""
-        log = self._logs[group]
-        for i, (term, data) in enumerate(zip(terms, payloads)):
-            pos = start - 1 + i
-            if pos < len(log):
-                log[pos] = (term, data)
-            elif pos == len(log):
-                log.append((term, data))
-            else:
-                raise ValueError(
-                    f"payload gap: group {group} idx {pos + 1} > "
-                    f"len {len(log)}")
-        if new_len is not None and new_len < len(log):
-            del log[new_len:]
+        with self._mu:
+            log = self._logs[group]
+            off = self._start[group]
+            for i, (term, data) in enumerate(zip(terms, payloads)):
+                pos = start - 1 + i - off
+                if pos < 0:
+                    continue    # below the compaction floor: immutable
+                if pos < len(log):
+                    log[pos] = (term, data)
+                elif pos == len(log):
+                    log.append((term, data))
+                else:
+                    raise ValueError(
+                        f"payload gap: group {group} idx {pos + 1 + off} "
+                        f"> len {len(log) + off}")
+            if new_len is not None and new_len - off < len(log):
+                del log[max(new_len - off, 0):]

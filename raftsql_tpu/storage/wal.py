@@ -40,9 +40,13 @@ from typing import Dict, List, Optional, Tuple
 _HDR = struct.Struct("<II")          # crc, body_len
 _ENTRY = struct.Struct("<BIQQ")      # type, group, index, term
 _HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
+_SNAP = struct.Struct("<BIQQ")       # type, group, index, term
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
+REC_SNAPSHOT = 3        # compaction boundary: entries <= index dropped,
+#                         term = term of the boundary entry (so AppendEntries
+#                         prev-term checks at the boundary still resolve)
 
 WAL_FILE = "wal-0.log"
 
@@ -56,13 +60,18 @@ class HardState:
 
 @dataclass
 class GroupLog:
-    """Replayed per-group state: 1-based entries plus last hard state."""
+    """Replayed per-group state: entries (start+1 ... start+len, 1-based)
+    plus last hard state.  `start` > 0 after WAL compaction — the prefix
+    up to `start` is covered by the state-machine snapshot; `start_term`
+    is the boundary entry's term."""
     hard: HardState = field(default_factory=HardState)
     entries: List[Tuple[int, bytes]] = field(default_factory=list)  # (term, data)
+    start: int = 0
+    start_term: int = 0
 
     @property
     def log_len(self) -> int:
-        return len(self.entries)
+        return self.start + len(self.entries)
 
 
 def wal_exists(dirname: str) -> bool:
@@ -147,6 +156,16 @@ class WAL:
             return
         self._write(_HARD.pack(REC_HARDSTATE, group, term, vote, commit))
 
+    def set_snapshot(self, group: int, index: int, term: int) -> None:
+        """Snapshot/compaction boundary marker: on replay, entries of
+        `group` at or below `index` are dropped and the log starts there
+        (with the boundary entry's term preserved)."""
+        if self._lib is not None:
+            self._lib.wal_set_snapshot(self._h, group, index, term)
+            self._pending = True
+            return
+        self._write(_SNAP.pack(REC_SNAPSHOT, group, index, term))
+
     def sync(self) -> None:
         if not self._pending:
             return
@@ -171,6 +190,42 @@ class WAL:
             self.sync()
             self._f.close()
 
+    # -- compaction ------------------------------------------------------
+
+    @staticmethod
+    def rewrite(dirname: str, groups: Dict[int, GroupLog]) -> None:
+        """Atomically replace the WAL with a compacted image.
+
+        `groups` is the desired post-compaction state: per group, a
+        snapshot boundary (start, start_term), the retained entry tail,
+        and the current hard state.  Written to a temp file, fsynced, then
+        renamed over the live WAL — a crash at any point leaves either the
+        old or the new WAL intact.  The caller must hold the WAL quiescent
+        (no concurrent appends) and reopen its handle afterwards.
+        """
+        path = os.path.join(dirname, WAL_FILE)
+        tmp = path + ".rewrite"
+        w = WAL.__new__(WAL)                      # bare python-backend WAL
+        w._lib = w._h = None
+        w.path = tmp
+        w._f = open(tmp, "wb")
+        w._pending = False
+        for g, gl in sorted(groups.items()):
+            if gl.start:
+                w.set_snapshot(g, gl.start, gl.start_term)
+            for i, (term, data) in enumerate(gl.entries):
+                w.append_entry(g, gl.start + 1 + i, term, data)
+            w.set_hardstate(g, gl.hard.term, gl.hard.vote, gl.hard.commit)
+        w.sync()
+        w.close()
+        os.replace(tmp, path)
+        # Durability of the rename itself.
+        dirfd = os.open(dirname, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+
     # -- replay ----------------------------------------------------------
 
     @staticmethod
@@ -194,17 +249,30 @@ class WAL:
                 _, group, index, term = _ENTRY.unpack_from(body)
                 data = body[_ENTRY.size:]
                 gl = groups.setdefault(group, GroupLog())
-                if 1 <= index <= len(gl.entries):
-                    if gl.entries[index - 1][0] == term:
-                        gl.entries[index - 1] = (term, data)
-                    else:                            # conflict truncation
-                        del gl.entries[index - 1:]
+                pos = index - gl.start           # 1-based within entries
+                if pos < 1:
+                    continue                     # below compaction floor
+                if pos <= len(gl.entries):
+                    if gl.entries[pos - 1][0] == term:
+                        gl.entries[pos - 1] = (term, data)
+                    else:                        # conflict truncation
+                        del gl.entries[pos - 1:]
                         gl.entries.append((term, data))
-                elif index == len(gl.entries) + 1:
+                elif pos == len(gl.entries) + 1:
                     gl.entries.append((term, data))
                 # else: a gap would mean WAL corruption; skip the record.
             elif rtype == REC_HARDSTATE:
                 _, group, term, vote, commit = _HARD.unpack_from(body)
                 gl = groups.setdefault(group, GroupLog())
                 gl.hard = HardState(term=term, vote=vote, commit=commit)
+            elif rtype == REC_SNAPSHOT:
+                _, group, index, term = _SNAP.unpack_from(body)
+                gl = groups.setdefault(group, GroupLog())
+                # Leads a rewritten WAL (no entries yet), or marks a live
+                # InstallSnapshot mid-stream: drop the covered prefix —
+                # AND any retained suffix, which predates the snapshot
+                # and may conflict with the installed state's history.
+                if index > gl.start:
+                    gl.entries.clear()
+                    gl.start, gl.start_term = index, term
         return groups

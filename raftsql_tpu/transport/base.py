@@ -62,14 +62,33 @@ class ProposalRec:
 
 
 @dataclass
+class SnapshotRec:
+    """InstallSnapshot: full state transfer for a follower whose needed
+    log prefix has been compacted away (raft §7; the reference has no
+    snapshots at all, db.go:27-29 — this is capability beyond parity).
+
+    `blob` is the state machine's serialized image at `last_idx` (whose
+    entry has term `last_term`); the receiver installs it, resets its
+    group log to start at last_idx, and resumes replication from there.
+    """
+    group: int
+    last_idx: int
+    last_term: int
+    term: int           # sender's (leader's) current term
+    blob: bytes = b""
+
+
+@dataclass
 class TickBatch:
     """Everything one node sends another for one tick."""
     votes: List[VoteRec] = field(default_factory=list)
     appends: List[AppendRec] = field(default_factory=list)
     proposals: List[ProposalRec] = field(default_factory=list)
+    snapshots: List[SnapshotRec] = field(default_factory=list)
 
     def empty(self) -> bool:
-        return not (self.votes or self.appends or self.proposals)
+        return not (self.votes or self.appends or self.proposals
+                    or self.snapshots)
 
 
 class Transport(Protocol):

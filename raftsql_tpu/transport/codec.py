@@ -8,11 +8,13 @@ machinery.  The same layout is shared with the C++ runtime shim.
 Frame := u32 n_votes  | VoteRec*
          u32 n_appends| AppendRec*
          u32 n_props  | ProposalRec*
+         u32 n_snaps  | SnapshotRec*
 VoteRec     := u32 group | u8 type | q term | q last_idx | q last_term | u8 granted
 AppendRec   := u32 group | u8 type | q term | q prev_idx | q prev_term
              | q commit | u8 success | q match | u16 n
              | q ent_term * n | (u32 len | bytes) * n_payloads(=n for REQ, 0 resp)
 ProposalRec := u32 group | u32 len | bytes
+SnapshotRec := u32 group | q last_idx | q last_term | q term | u32 len | bytes
 """
 from __future__ import annotations
 
@@ -20,13 +22,14 @@ import struct
 from typing import List, Tuple
 
 from raftsql_tpu.config import MSG_REQ
-from raftsql_tpu.transport.base import (AppendRec, ProposalRec, TickBatch,
-                                        VoteRec)
+from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
+                                        TickBatch, VoteRec)
 
 _U32 = struct.Struct("<I")
 _VOTE = struct.Struct("<IBqqqB")
 _APP = struct.Struct("<IBqqqqBqH")
 _PLEN = struct.Struct("<I")
+_SNAP = struct.Struct("<Iqqq")
 
 
 def encode_batch(batch: TickBatch) -> bytes:
@@ -51,6 +54,11 @@ def encode_batch(batch: TickBatch) -> bytes:
         out.append(_U32.pack(pr.group))
         out.append(_PLEN.pack(len(pr.payload)))
         out.append(pr.payload)
+    out.append(_U32.pack(len(batch.snapshots)))
+    for s in batch.snapshots:
+        out.append(_SNAP.pack(s.group, s.last_idx, s.last_term, s.term))
+        out.append(_PLEN.pack(len(s.blob)))
+        out.append(s.blob)
     return b"".join(out)
 
 
@@ -93,4 +101,14 @@ def decode_batch(blob: bytes) -> TickBatch:
         batch.proposals.append(ProposalRec(group=g,
                                            payload=blob[off:off + plen]))
         off += plen
+    if off < len(blob):
+        (ns,) = take(_U32)
+        for _ in range(ns):
+            g, li, lt, term = take(_SNAP)
+            (blen,) = _PLEN.unpack_from(blob, off)
+            off += _PLEN.size
+            batch.snapshots.append(SnapshotRec(
+                group=g, last_idx=li, last_term=lt, term=term,
+                blob=blob[off:off + blen]))
+            off += blen
     return batch

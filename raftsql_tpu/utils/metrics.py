@@ -19,6 +19,9 @@ class NodeMetrics:
     commits: int = 0
     msgs_sent: int = 0
     catchup_appends: int = 0
+    compactions: int = 0
+    snapshots_sent: int = 0
+    snapshots_installed: int = 0
     started_at: float = field(default_factory=time.monotonic)
 
     def snapshot(self) -> dict:
@@ -29,6 +32,9 @@ class NodeMetrics:
             "commits": self.commits,
             "msgs_sent": self.msgs_sent,
             "catchup_appends": self.catchup_appends,
+            "compactions": self.compactions,
+            "snapshots_sent": self.snapshots_sent,
+            "snapshots_installed": self.snapshots_installed,
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
         }
