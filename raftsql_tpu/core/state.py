@@ -207,7 +207,8 @@ import functools
 @functools.partial(jax.jit, donate_argnums=0, static_argnums=4)
 def install_snapshot_state(state: PeerState, g: jax.Array,
                            last_idx: jax.Array, last_term: jax.Array,
-                           window: int) -> PeerState:
+                           window: int, sender_term: jax.Array
+                           ) -> PeerState:
     """Reset group `g`'s device row to a snapshot boundary.
 
     The follower installed a state-machine image at log position
@@ -216,12 +217,23 @@ def install_snapshot_state(state: PeerState, g: jax.Array,
     except the boundary slot, and the row drops to follower so normal
     replication resumes from last_idx + 1 (raft §7 InstallSnapshot; no
     analog in the reference, which never snapshots, db.go:27-29).
+
+    `sender_term` is the sending leader's term: a higher term is adopted
+    (vote cleared), exactly as any raft RPC with term > currentTerm.  The
+    caller must have already rejected sender_term < currentTerm — this
+    function cannot, because the install itself (log/commit jump) must
+    not happen for stale senders.
     """
     g = jnp.asarray(g, I32)
     last_idx = jnp.asarray(last_idx, I32)
     ring = jnp.zeros((window,), I32).at[(last_idx - 1) % window].set(
         jnp.asarray(last_term, I32))
+    sender_term = jnp.asarray(sender_term, I32)
+    newer = sender_term > state.term[g]
     return state._replace(
+        term=state.term.at[g].set(jnp.maximum(state.term[g], sender_term)),
+        voted_for=state.voted_for.at[g].set(
+            jnp.where(newer, NO_VOTE, state.voted_for[g])),
         log_len=state.log_len.at[g].set(last_idx),
         commit=state.commit.at[g].set(last_idx),
         log_term=state.log_term.at[g].set(ring),

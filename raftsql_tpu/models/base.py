@@ -32,7 +32,12 @@ class StateMachine(Protocol):
     def applied_index(self) -> int:
         """Durable log index of the last applied entry; 0 if fresh or not
         tracked.  Only meaningful when the machine persists it atomically
-        with apply (see SQLiteStateMachine resume mode)."""
+        with apply (see SQLiteStateMachine resume mode).
+
+        Machines whose applied_index survives a process crash advertise it
+        with a truthy `has_durable_snapshot` attribute; the engine treats
+        everything else as floor 0 for WAL compaction (compacting on a
+        volatile index silently loses data on restart)."""
         ...
 
     def close(self) -> None: ...

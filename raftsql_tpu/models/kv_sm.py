@@ -14,6 +14,10 @@ from typing import Dict, Optional
 
 
 class KVStateMachine:
+    # _applied is volatile: a restart loses it, so it must never be used
+    # as a WAL-compaction floor (runtime/db.py gates on this flag).
+    has_durable_snapshot = False
+
     def __init__(self, path: str = ""):
         self._data: Dict[str, str] = {}
         self._lock = threading.Lock()

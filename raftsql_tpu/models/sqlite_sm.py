@@ -53,6 +53,9 @@ class SQLiteStateMachine:
             os.remove(path)
         self.path = path
         self.resume = resume
+        # WAL compaction may only trust applied_index() as a floor when it
+        # survives a crash (models/base.py contract).
+        self.has_durable_snapshot = resume and path != ":memory:"
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         self._applied = 0

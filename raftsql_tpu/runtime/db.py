@@ -81,6 +81,12 @@ class RaftDB:
         self._applies_since_compact = 0
         self._sms: Dict[int, StateMachine] = {
             g: sm_factory(g) for g in range(num_groups)}
+        if not any(getattr(sm, "has_durable_snapshot", False)
+                   for sm in self._sms.values()):
+            # All floors would be 0 (volatile applied indexes must not
+            # gate WAL compaction) — a guaranteed no-op; don't take
+            # _wal_lock for it every compact_every applies.
+            self._compact_every = 0
         if resume:
             # Full state transfer for followers beyond the compaction
             # floor (InstallSnapshot) is only sound when re-apply is
@@ -165,7 +171,12 @@ class RaftDB:
         if self._applies_since_compact < self._compact_every:
             return
         self._applies_since_compact = 0
-        applied = {g: sm.applied_index() for g, sm in self._sms.items()}
+        # Volatile applied indexes (has_durable_snapshot unset/False) are
+        # floored at 0: compacting the WAL against state lost on restart
+        # would be silent data loss (models/base.py contract).
+        applied = {g: (sm.applied_index()
+                       if getattr(sm, "has_durable_snapshot", False) else 0)
+                   for g, sm in self._sms.items()}
         self.pipe.node.compact(applied, keep=self._compact_keep)
 
     def propose(self, query: str, group: int = 0) -> AckFuture:

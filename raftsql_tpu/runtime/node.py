@@ -38,7 +38,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import LEADER, MSG_REQ, MSG_RESP, NO_VOTE, RaftConfig
+from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP, NO_VOTE,
+                                RaftConfig)
 from raftsql_tpu.core.state import (Inbox, init_peer_state,
                                     install_snapshot_state,
                                     restore_peer_state, set_peer_progress)
@@ -93,6 +94,11 @@ class RaftNode:
         self.snapshot_installer = None
         self._snap_sent: Dict[Tuple[int, int], int] = {}
         self._snap_due: List[Tuple[int, int, int]] = []
+        # Catch-up pacing: (group, dst) -> (next_idx last sent for, tick).
+        # Rebuilding + resending the same out-of-window append every tick
+        # is pure bandwidth waste; resend only on next_idx progress or
+        # after a few ticks without it.
+        self._catchup_sent: Dict[Tuple[int, int], Tuple[int, int]] = {}
 
         self._prop_lock = threading.Lock()
         self._props: List[deque] = [deque() for _ in range(G)]
@@ -354,10 +360,29 @@ class RaftNode:
             snaps, self._stage_snaps = self._stage_snaps, {}
         if not snaps:
             return
-        commit = None
+        commit = term = None
         for g, rec in snaps.items():
             if commit is None:
                 commit = np.asarray(self.state.commit)
+                # Writable copy: adopted terms are folded back in so a
+                # second staged snapshot for the same group sees them.
+                term = np.array(self.state.term)
+            if rec.term < int(term[g]):
+                # Raft: reject any RPC whose term < currentTerm — a
+                # delayed transfer from a deposed leader must not demote
+                # a current-term leader or truncate its tail.
+                continue
+            if rec.term > int(term[g]):
+                # A valid higher-term RPC steps this group down on
+                # RECEIPT (raft §5.1), even if the transfer itself turns
+                # out to be a duplicate or corrupt below.
+                st = self.state
+                self.state = st._replace(
+                    term=st.term.at[g].set(rec.term),
+                    voted_for=st.voted_for.at[g].set(NO_VOTE),
+                    role=st.role.at[g].set(FOLLOWER),
+                    votes=st.votes.at[g].set(False))
+                term[g] = rec.term
             if rec.last_idx <= max(self._applied[g], int(commit[g])):
                 continue
             try:
@@ -373,14 +398,19 @@ class RaftNode:
             # see the data the moment the state machine has it, while the
             # device-state patch below may still be compiling.
             self.metrics.snapshots_installed += 1
-            self.payload_log.reset(g, rec.last_idx, rec.last_term)
+            # The whole install — payload-log reset, WAL marker, device
+            # patch, applied floor — is one atomic unit vs. compact()'s
+            # multi-call read of the payload log (it holds _wal_lock for
+            # its image build); a reset racing that read corrupts the
+            # rewritten WAL.
             with self._wal_lock:
+                self.payload_log.reset(g, rec.last_idx, rec.last_term)
                 self.wal.set_snapshot(g, rec.last_idx, rec.last_term)
                 self.wal.sync()
-            self.state = install_snapshot_state(
-                self.state, g, rec.last_idx, rec.last_term,
-                self.cfg.log_window)
-            self._applied[g] = rec.last_idx
+                self.state = install_snapshot_state(
+                    self.state, g, rec.last_idx, rec.last_term,
+                    self.cfg.log_window, rec.term)
+                self._applied[g] = rec.last_idx
             log.info("node %d g%d: installed snapshot at idx %d",
                      self.node_id, g, rec.last_idx)
 
@@ -521,6 +551,10 @@ class RaftNode:
         for g, d in zip(*np.nonzero(lag)):
             g, d = int(g), int(d)
             ni = int(next_idx[g, d])
+            prev_sent = self._catchup_sent.get((g, d))
+            if prev_sent is not None and prev_sent[0] == ni \
+                    and self._tick_no - prev_sent[1] < 4:
+                continue        # no progress yet; give the ack time
             avail = self.payload_log.length(g)
             n = min(E, avail - ni + 1)
             got = self.payload_log.try_tail_with_terms(g, ni, n) \
@@ -532,6 +566,7 @@ class RaftNode:
                     self._snap_due.append((g, d, int(term[g])))
                 continue
             prev_term, ents = got
+            self._catchup_sent[(g, d)] = (ni, self._tick_no)
             out[(g, d)] = AppendRec(
                 group=g, type=MSG_REQ, term=int(term[g]),
                 prev_idx=ni - 1, prev_term=prev_term,
@@ -559,7 +594,9 @@ class RaftNode:
                 last_term=int(outbox.v_last_term[g, d]),
                 granted=bool(outbox.v_granted[g, d])))
         ag, ad = np.nonzero(outbox.a_type)
+        emitted = set()
         for g, d in zip(ag.tolist(), ad.tolist()):
+            emitted.add((g, d))
             mtype = int(outbox.a_type[g, d])
             cu = catchups.pop((g, d), None) if mtype == MSG_REQ else None
             if cu is not None:
@@ -590,6 +627,13 @@ class RaftNode:
                 success=bool(outbox.a_success[g, d]),
                 match=int(outbox.a_match[g, d])))
         for (g, d), cu in catchups.items():
+            if (g, d) in emitted:
+                # The device emitted a (response) message for this slot;
+                # the receiver stages one append per (group, src), newest
+                # wins — don't clobber it.  Un-record the pacing entry so
+                # the catch-up is rebuilt next tick, not in 4.
+                self._catchup_sent.pop((g, d), None)
+                continue
             batch_for(d).appends.append(cu)
 
         # InstallSnapshot dispatch (rate-limited: transfers are bulky and

@@ -168,6 +168,69 @@ class TestClusterResume:
                 db.close()
 
 
+class TestSnapshotTermCheck:
+    """Receiver-side term rule for InstallSnapshot (raft: reject RPCs with
+    term < currentTerm; adopt term > currentTerm)."""
+
+    def _node(self, tmp_path):
+        from raftsql_tpu.runtime.node import RaftNode
+        hub = LoopbackHub()
+        cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=TICK,
+                         log_window=16, max_entries_per_msg=4)
+        node = RaftNode(1, 3, cfg, LoopbackTransport(hub),
+                        str(tmp_path / "raftsql-1"))
+        installs = []
+        node.snapshot_installer = \
+            lambda g, idx, blob: installs.append((g, idx, blob))
+        return node, installs
+
+    def test_stale_term_snapshot_rejected(self, tmp_path):
+        import jax.numpy as jnp
+
+        from raftsql_tpu.transport.base import SnapshotRec
+        node, installs = self._node(tmp_path)
+        node.state = node.state._replace(
+            term=node.state.term.at[0].set(5))
+        node._stage_snaps[0] = SnapshotRec(
+            group=0, last_idx=50, last_term=3, term=3, blob=b"{}")
+        node._install_snapshots()
+        assert installs == []           # deposed leader's transfer dropped
+        assert int(node.state.term[0]) == 5
+        assert int(node.state.commit[0]) == 0
+
+    def test_higher_term_duplicate_still_steps_down(self, tmp_path):
+        """Term adoption fires on receipt of a valid higher-term RPC even
+        when the transfer itself is a duplicate (raft §5.1)."""
+        from raftsql_tpu.config import FOLLOWER, LEADER
+        from raftsql_tpu.transport.base import SnapshotRec
+        node, installs = self._node(tmp_path)
+        node.state = node.state._replace(
+            term=node.state.term.at[0].set(5),
+            role=node.state.role.at[0].set(LEADER),
+            commit=node.state.commit.at[0].set(60))
+        node._stage_snaps[0] = SnapshotRec(
+            group=0, last_idx=50, last_term=7, term=7, blob=b"{}")
+        node._install_snapshots()
+        assert installs == []           # last_idx <= commit: not installed
+        assert int(node.state.term[0]) == 7
+        assert int(node.state.role[0]) == FOLLOWER
+
+    def test_higher_term_snapshot_adopts_term(self, tmp_path):
+        from raftsql_tpu.transport.base import SnapshotRec
+        node, installs = self._node(tmp_path)
+        node.state = node.state._replace(
+            term=node.state.term.at[0].set(5),
+            voted_for=node.state.voted_for.at[0].set(2))
+        node._stage_snaps[0] = SnapshotRec(
+            group=0, last_idx=50, last_term=7, term=7, blob=b"{}")
+        node._install_snapshots()
+        assert installs == [(0, 50, b"{}")]
+        assert int(node.state.term[0]) == 7      # term catch-up
+        assert int(node.state.commit[0]) == 50
+        from raftsql_tpu.config import NO_VOTE
+        assert int(node.state.voted_for[0]) == NO_VOTE
+
+
 class TestInstallSnapshot:
     def test_follower_beyond_floor_gets_full_transfer(self, tmp_path):
         """Kill a follower, write + compact far past its position, then
