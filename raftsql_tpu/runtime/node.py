@@ -120,6 +120,7 @@ class RaftNode:
         self._hard_cache: Dict[int, Tuple[int, int, int]] = {}
 
         self._stop_evt = threading.Event()
+        self._stopped = False           # full teardown ran (stop())
         self._thread: Optional[threading.Thread] = None
         self._tick_apps: Dict[Tuple[int, int], AppendRec] = {}
         # Serializes the tick's WAL phase against compaction rewrites.
@@ -169,8 +170,12 @@ class RaftNode:
         self._thread.start()
 
     def stop(self) -> None:
-        if self._stop_evt.is_set():
+        # _on_error may have set _stop_evt already (transport failure
+        # teardown); the transport/WAL cleanup below must STILL run then —
+        # only a completed stop() makes a second call a no-op.
+        if self._stopped:
             return
+        self._stopped = True
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=10)

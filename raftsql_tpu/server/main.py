@@ -17,6 +17,24 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
+
+
+def _pin_platform_from_env() -> None:
+    """Make JAX_PLATFORMS effective even when a site hook captured jax
+    config at interpreter startup.
+
+    This environment's sitecustomize registers a remote-TPU ("axon") PJRT
+    plugin in every python process and forces its own platform list into
+    the live jax config, so the operator's JAX_PLATFORMS=cpu would
+    otherwise be silently ignored — and a wedged TPU tunnel would hang
+    the server at its first device computation.  Re-applying the env var
+    to the live config before any device access restores the documented
+    contract (same hazard + fix as tests/conftest.py)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
 
 from raftsql_tpu.api.http import serve_http_sql_api
 from raftsql_tpu.config import RaftConfig
@@ -66,6 +84,7 @@ def main(argv=None) -> None:
                          "snapshot-covered prefixes every N applies")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    _pin_platform_from_env()
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")

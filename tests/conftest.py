@@ -28,3 +28,36 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
+
+
+def free_port() -> int:
+    """An OS-assigned localhost port.  Bind-and-release has the usual
+    TOCTOU window: the OS may hand the released port to someone else
+    before the caller binds it.  Ephemeral-range collisions are rare and
+    the suites run nodes that fail loudly on bind conflict; callers that
+    need a narrower window should reserve with `reserve_ports` instead."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def reserve_ports(n: int):
+    """Bind n distinct localhost ports and HOLD them; returns
+    (ports, release) where release() closes the sockets.  Guarantees
+    in-batch uniqueness and shrinks the reuse window to after release."""
+    import socket
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+
+    def release():
+        for s in socks:
+            s.close()
+
+    return ports, release

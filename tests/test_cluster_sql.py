@@ -214,6 +214,40 @@ def test_bad_sql_propagates_apply_error(tmp_cluster):
     assert err is not None
 
 
+def test_transport_error_fans_out_to_pending_acks(tmp_cluster):
+    """Transport failure → every pending ack receives the error and the
+    node tears down (reference raft.go:136-142, db.go:83-95).
+
+    The proposing node is partitioned first so its proposals can never
+    commit, then the transport's on_error callback fires — the exact path
+    a fatal listener failure takes (transport/tcp.py _accept_loop)."""
+    clus = tmp_cluster
+    err = clus.dbs[0].propose("CREATE TABLE main.e (x text)").wait(TIMEOUT)
+    assert err is None, err
+
+    clus.hub.faults.isolate(1, range(1, 4))       # node index 0 == id 1
+    futs = [clus.dbs[0].propose(
+        f'INSERT INTO main.e (x) VALUES ("{k}")') for k in range(3)]
+    time.sleep(0.1)                               # let them enter flight
+    for f in futs:
+        assert not f._evt.is_set()                # stuck without quorum
+
+    boom = RuntimeError("transport exploded")
+    clus.dbs[0].pipe.node._on_error(boom)
+
+    for f in futs:
+        assert f.wait(TIMEOUT) is boom            # fan-out, not a hang
+    # The node is down: new proposals fail fast with the same error.
+    assert clus.dbs[0].propose(
+        'INSERT INTO main.e (x) VALUES ("late")').wait(TIMEOUT) is boom
+    clus.hub.faults.heal()
+    clus.stop_node(0)
+    # Survivors keep running (they hold quorum without the dead node).
+    err = clus.dbs[1].propose(
+        'INSERT INTO main.e (x) VALUES ("alive")').wait(TIMEOUT)
+    assert err is None, err
+
+
 def test_multi_group_isolation(tmp_path):
     """Groups are independent logs applied to independent DB files — the
     batched engine's reason to exist (BASELINE.json north star)."""
