@@ -35,6 +35,10 @@ NO_VOTE = -1
 # leader_hint sentinel: leader unknown.
 NO_LEADER = -1
 
+# Default WAL segment rotation threshold (storage/wal.py; also the CLI's
+# --wal-segment-bytes default).
+WAL_SEGMENT_BYTES_DEFAULT = 4 << 20
+
 
 @dataclasses.dataclass(frozen=True)
 class RaftConfig:
@@ -64,6 +68,12 @@ class RaftConfig:
     # ops/pallas_quorum.py).  All are safe; they differ in how eagerly an
     # old-term quorum index commits and in lowering strategy.
     commit_rule: str = "point"
+
+    # WAL segment rotation threshold (bytes): the durable log is a
+    # directory of bounded files so compaction can unlink whole segments
+    # instead of rewriting live data (storage/wal.py; etcd/wal's segment
+    # dir as opened at reference raft.go:99-117).
+    wal_segment_bytes: int = WAL_SEGMENT_BYTES_DEFAULT
 
     seed: int = 0
 

@@ -12,6 +12,8 @@
 //   body := u8 type | fields
 //     type 1 ENTRY:     u32 group | u64 index | u64 term | bytes data
 //     type 2 HARDSTATE: u32 group | u64 term  | i64 vote | u64 commit
+//     type 3 SNAPSHOT:  u32 group | u64 index | u64 term
+//     type 4 COMPACT:   u32 group | u64 index | u64 term
 //
 // Build: g++ -O2 -shared -fPIC -o _native_wal.so wal.cc
 // ABI: plain C, consumed via ctypes (no pybind11 in this environment).
@@ -138,6 +140,24 @@ int wal_set_snapshot(void* h, uint32_t group, uint64_t index,
   std::vector<uint8_t> body;
   body.reserve(21);
   body.push_back(3);
+  put_u32(body, group);
+  put_u64(body, index);
+  put_u64(body, term);
+  std::lock_guard<std::mutex> lk(w->mu);
+  frame(w, body);
+  return 0;
+}
+
+// Compaction floor marker (type 4): on replay, entries of `group` at or
+// below `index` are dropped while the retained suffix SURVIVES — unlike
+// the snapshot marker (type 3), which also clears the suffix because an
+// installed state's history may conflict with it.
+int wal_set_compact(void* h, uint32_t group, uint64_t index,
+                    uint64_t term) {
+  Wal* w = static_cast<Wal*>(h);
+  std::vector<uint8_t> body;
+  body.reserve(21);
+  body.push_back(4);
   put_u32(body, group);
   put_u64(body, index);
   put_u64(body, term);

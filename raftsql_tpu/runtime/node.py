@@ -150,7 +150,7 @@ class RaftNode:
             # (raft.go:84-87).
             self._applied[g] = gl.log_len
         self._replay_groups = groups
-        self.wal = WAL(data_dir)
+        self.wal = WAL(data_dir, segment_bytes=cfg.wal_segment_bytes)
         self._self_arr = jnp.asarray(self.self_id, jnp.int32)
 
     # ------------------------------------------------------------------
@@ -228,26 +228,25 @@ class RaftNode:
 
         `applied[g]` is the index durably applied by the snapshot-capable
         state machine.  Entries up to min(applied, commit) - keep are
-        dropped from the payload log, and the WAL is atomically rewritten
-        to {snapshot marker, retained tail, hard state} per group.  The
-        retained `keep` window lets slow followers catch up from the
-        payload log (runtime catch-up path); a follower lagging beyond it
-        needs a full state transfer, which is not yet implemented — hence
-        the generous default; beyond it, the leader ships a full state
-        transfer (InstallSnapshot, _send_phase).
+        dropped from the payload log, COMPACT floor markers are appended
+        to the WAL's active segment, and whole closed segments below
+        every floor are unlinked (storage/wal.py compact) — never a
+        stop-the-world rewrite of live data, so the tick's WAL phase is
+        blocked only for the marker appends + unlinks.  The retained
+        `keep` window lets slow followers catch up from the payload log;
+        beyond it, the leader ships a full state transfer
+        (InstallSnapshot, _send_phase).
 
         Returns True if anything was compacted.
         """
-        from raftsql_tpu.storage.wal import GroupLog, HardState
-
         # Never compact into the device ring window: the ordinary send
         # path slices payloads for any in-window prev index.
         keep = max(keep, self.cfg.log_window)
         with self._wal_lock:
             changed = False
-            image: Dict[int, GroupLog] = {}
+            floors: Dict[int, Tuple[int, int]] = {}
             for g in range(self.cfg.num_groups):
-                term, vote, commit = self._hard_cache.get(g, (0, -1, 0))
+                _, _, commit = self._hard_cache.get(g, (0, -1, 0))
                 floor = min(applied.get(g, 0), commit,
                             self._applied[g]) - keep
                 if floor > self.payload_log.start(g):
@@ -255,17 +254,11 @@ class RaftNode:
                         g, floor, self.payload_log.term_of(g, floor))
                     changed = True
                 s = self.payload_log.start(g)
-                n = self.payload_log.length(g) - s
-                image[g] = GroupLog(
-                    hard=HardState(term=term, vote=vote, commit=commit),
-                    entries=self.payload_log.slice_with_terms(g, s + 1, n),
-                    start=s,
-                    start_term=self.payload_log.term_of(g, s) if s else 0)
+                if s > 0:
+                    floors[g] = (s, self.payload_log.term_of(g, s))
             if not changed:
                 return False
-            self.wal.close()
-            WAL.rewrite(self.data_dir, image)
-            self.wal = WAL(self.data_dir)
+            self.wal.compact(floors, self._hard_cache)
             self.metrics.compactions += 1
             return True
 

@@ -47,10 +47,12 @@ from raftsql_tpu.transport.tcp import TcpTransport
 def build_node(cluster: str, node_id: int, groups: int = 1,
                tick: float = 0.01, election_ticks: int = 10,
                data_prefix: str = "raftsql", resume: bool = False,
-               compact_every: int = 0) -> RaftDB:
+               compact_every: int = 0, compact_keep: int = 1024,
+               wal_segment_bytes: int = 4 << 20) -> RaftDB:
     peers = cluster.split(",")
     cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
-                     tick_interval_s=tick, election_ticks=election_ticks)
+                     tick_interval_s=tick, election_ticks=election_ticks,
+                     wal_segment_bytes=wal_segment_bytes)
     transport = TcpTransport(peers, node_id - 1)
     pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
                            data_dir=f"{data_prefix}-{node_id}")
@@ -61,7 +63,7 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
         return SQLiteStateMachine(path, resume=resume)
 
     return RaftDB(sm_factory, pipe, num_groups=groups, resume=resume,
-                  compact_every=compact_every)
+                  compact_every=compact_every, compact_keep=compact_keep)
 
 
 def main(argv=None) -> None:
@@ -80,8 +82,14 @@ def main(argv=None) -> None:
                          "restarts and skip re-applying the replayed "
                          "prefix (default: reference delete-and-replay)")
     ap.add_argument("--compact-every", type=int, default=0,
-                    help="with --resume: rewrite the WAL dropping "
-                         "snapshot-covered prefixes every N applies")
+                    help="with --resume: advance WAL compaction floors "
+                         "(and drop covered segments) every N applies")
+    ap.add_argument("--compact-keep", type=int, default=1024,
+                    help="entries retained above the compaction floor "
+                         "for follower catch-up")
+    ap.add_argument("--wal-segment-bytes", type=int, default=4 << 20,
+                    help="rotate WAL segments at this size; compaction "
+                         "unlinks whole covered segments")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -91,7 +99,9 @@ def main(argv=None) -> None:
 
     rdb = build_node(args.cluster, args.id, groups=args.groups,
                      tick=args.tick, resume=args.resume,
-                     compact_every=args.compact_every)
+                     compact_every=args.compact_every,
+                     compact_keep=args.compact_keep,
+                     wal_segment_bytes=args.wal_segment_bytes)
     serve_http_sql_api(args.port, rdb)
 
 

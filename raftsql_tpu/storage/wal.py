@@ -1,4 +1,4 @@
-"""Durable write-ahead log, multi-group, host-side.
+"""Durable write-ahead log, multi-group, host-side, segmented.
 
 Replaces the reference's vendored `etcd/wal` (reference raft.go:33-34,
 99-134): an append-only record log that persists raft entries and hard
@@ -14,10 +14,23 @@ Differences from etcd/wal, by design:
     friendly, shared with the C++ fast path in native/wal.cc, loaded via
     storage.native_wal when built).
 
+Segmentation (the same shape as etcd/wal's segment directory, which the
+reference opens at raft.go:99-117): the log is a directory of bounded
+files `wal-<seq>.log`; appends go to the highest sequence ("active")
+segment, a segment that exceeds `segment_bytes` is closed at the next
+sync boundary and a fresh one opened.  Compaction never rewrites live
+data: it appends per-group COMPACT floor markers to the active segment,
+then unlinks whole closed segments whose every record is superseded —
+O(appended markers + unlink), not O(log).  Replay concatenates segments
+in sequence order, so the byte format within each segment is exactly the
+single-file format (the C++ fast path is unchanged per segment).
+
 Record layout:  u32 crc32(body) | u32 body_len | body
   body := u8 type | fields
   type 1 ENTRY:     u32 group | u64 index | u64 term | bytes data
   type 2 HARDSTATE: u32 group | u64 term | i64 vote | u64 commit
+  type 3 SNAPSHOT:  u32 group | u64 index | u64 term
+  type 4 COMPACT:   u32 group | u64 index | u64 term
 
 Replay semantics match raft's log-matching property: a later ENTRY record
 at an index <= the current length with the SAME term is an idempotent
@@ -26,29 +39,62 @@ entry), while a DIFFERENT term is a genuine conflict and truncates the
 suffix from that index before appending (core/step.py Phase 4).  Truncating
 on same-term overlap would silently drop durably-acked suffix entries when
 a stale duplicate append covering only a prefix is re-accepted.  The last
-HARDSTATE per group wins.  A torn tail (bad CRC / short read) is dropped,
-like etcd's repair path.
+HARDSTATE per group wins.  SNAPSHOT (an InstallSnapshot boundary) drops
+the covered prefix AND the retained suffix — the installed state's
+history may conflict with it; COMPACT (a local compaction floor) drops
+only the covered prefix.  A torn record (bad CRC / short read) drops
+everything from that point on — only the active segment's tail can
+legitimately be torn.
 """
 from __future__ import annotations
 
 import os
+import re
 import struct
 import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 _HDR = struct.Struct("<II")          # crc, body_len
 _ENTRY = struct.Struct("<BIQQ")      # type, group, index, term
 _HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
-_SNAP = struct.Struct("<BIQQ")       # type, group, index, term
+_SNAP = struct.Struct("<BIQQ")       # type, group, index, term (also COMPACT)
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
-REC_SNAPSHOT = 3        # compaction boundary: entries <= index dropped,
-#                         term = term of the boundary entry (so AppendEntries
-#                         prev-term checks at the boundary still resolve)
+REC_SNAPSHOT = 3        # install boundary: entries <= index AND the
+#                         retained suffix dropped (conflicting history)
+REC_COMPACT = 4         # compaction floor: entries <= index dropped,
+#                         retained suffix kept
 
-WAL_FILE = "wal-0.log"
+_SEG_RE = re.compile(r"^wal-(\d+)\.log$")
+# Single source of truth for the default lives in config (the CLI and
+# RaftConfig share it).
+from raftsql_tpu.config import \
+    WAL_SEGMENT_BYTES_DEFAULT as DEFAULT_SEGMENT_BYTES  # noqa: E402
+
+
+def _segment_paths(dirname: str) -> List[Tuple[int, str]]:
+    """[(seq, abspath)] of existing segments, sequence order."""
+    out = []
+    try:
+        names = os.listdir(dirname)
+    except FileNotFoundError:
+        return []
+    for n in names:
+        m = _SEG_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dirname, n)))
+    out.sort()
+    return out
+
+
+def _fsync_dir(dirname: str) -> None:
+    dirfd = os.open(dirname, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
 
 
 @dataclass
@@ -74,12 +120,28 @@ class GroupLog:
         return self.start + len(self.entries)
 
 
+@dataclass
+class _SegStats:
+    """What a closed segment contains, for deletability decisions:
+    per-group max index referenced by ENTRY/SNAPSHOT/COMPACT records, and
+    the set of groups with HARDSTATE records."""
+    max_idx: Dict[int, int] = field(default_factory=dict)
+    hs: Set[int] = field(default_factory=set)
+
+    def bump(self, group: int, index: int) -> None:
+        if index > self.max_idx.get(group, -1):
+            self.max_idx[group] = index
+
+    def groups(self) -> Set[int]:
+        return set(self.max_idx) | self.hs
+
+
 def wal_exists(dirname: str) -> bool:
-    return os.path.isfile(os.path.join(dirname, WAL_FILE))
+    return bool(_segment_paths(dirname))
 
 
 class WAL:
-    """Append-only multi-group WAL with batched fsync.
+    """Append-only segmented multi-group WAL with batched fsync.
 
     Usage per tick (the reference's Ready handling, raft.go:227-235):
         wal.append_entry(...); wal.set_hardstate(...)
@@ -89,24 +151,70 @@ class WAL:
     CRC, buffered write, fdatasync behind one ctypes call) and falls back
     to pure Python; both produce byte-identical files, and `replay` reads
     either.  `native=None` auto-detects; True/False force.
+
+    NOT thread-safe: callers serialize all writes, sync, and compact (the
+    node holds its _wal_lock across every call).
     """
 
-    def __init__(self, dirname: str, native: Optional[bool] = None):
+    def __init__(self, dirname: str, native: Optional[bool] = None,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         os.makedirs(dirname, exist_ok=True)
-        self.path = os.path.join(dirname, WAL_FILE)
+        self.dirname = dirname
+        self.segment_bytes = segment_bytes
+        segs = _segment_paths(dirname)
+        self._seq = segs[-1][0] if segs else 0
+        self.path = os.path.join(dirname, f"wal-{self._seq}.log")
+        self._native_pref = native
         self._lib = None
         self._h = None
-        if native is not False:
+        self._f = None
+        self._pending = False
+        # A crash can tear the active segment's tail.  Appending AFTER
+        # torn bytes would hide every later record from replay (it stops
+        # at the first bad CRC) — durably-acked writes would vanish on the
+        # next restart.  Truncate to the last whole record before opening
+        # for append (etcd's repair path does the same).
+        self._bytes = self._repair_tail(self.path)
+        # Active-segment stats accumulate as we write; closed segments
+        # written before this process are scanned lazily (compact()).
+        self._active_stats = _SegStats()
+        self._closed_stats: Dict[str, _SegStats] = {}
+        self._marker_floor: Dict[int, int] = {}
+        self._open_active()
+
+    @staticmethod
+    def _repair_tail(path: str) -> int:
+        """Truncate `path` to its longest valid record prefix; returns
+        the resulting size (0 for a missing file)."""
+        if not os.path.isfile(path):
+            return 0
+        with open(path, "rb") as f:
+            blob = f.read()
+        off = 0
+        while off + _HDR.size <= len(blob):
+            crc, blen = _HDR.unpack_from(blob, off)
+            body = blob[off + _HDR.size: off + _HDR.size + blen]
+            if len(body) != blen or zlib.crc32(body) != crc:
+                break
+            off += _HDR.size + blen
+        if off < len(blob):
+            with open(path, "r+b") as f:
+                f.truncate(off)
+                f.flush()
+                os.fsync(f.fileno())
+        return off
+
+    def _open_active(self) -> None:
+        if self._native_pref is not False:
             from raftsql_tpu.native.build import load_native_wal
             lib = load_native_wal()
             if lib is not None:
                 h = lib.wal_open(self.path.encode())
                 if h:
                     self._lib, self._h = lib, h
-            if native is True and self._lib is None:
+            if self._native_pref is True and self._lib is None:
                 raise RuntimeError("native WAL requested but unavailable")
         self._f = None if self._lib else open(self.path, "ab")
-        self._pending = False
 
     @property
     def is_native(self) -> bool:
@@ -118,13 +226,16 @@ class WAL:
         self._f.write(_HDR.pack(zlib.crc32(body), len(body)))
         self._f.write(body)
         self._pending = True
+        self._bytes += _HDR.size + len(body)
 
     def append_entry(self, group: int, index: int, term: int,
                      data: bytes) -> None:
+        self._active_stats.bump(group, index)
         if self._lib is not None:
             self._lib.wal_append_entry(self._h, group, index, term,
                                        data, len(data))
             self._pending = True
+            self._bytes += _HDR.size + _ENTRY.size + len(data)
             return
         self._write(_ENTRY.pack(REC_ENTRY, group, index, term) + data)
 
@@ -138,6 +249,8 @@ class WAL:
         n = len(groups)
         if n == 0:
             return
+        for g, i in zip(groups, indexes):
+            self._active_stats.bump(g, i)
         blob = b"".join(datas)
         self._lib.wal_append_entries(
             self._h, n,
@@ -147,24 +260,47 @@ class WAL:
             blob,
             (ctypes.c_uint32 * n)(*[len(d) for d in datas]))
         self._pending = True
+        self._bytes += n * (_HDR.size + _ENTRY.size) + len(blob)
 
     def set_hardstate(self, group: int, term: int, vote: int,
                       commit: int) -> None:
+        self._active_stats.hs.add(group)
         if self._lib is not None:
             self._lib.wal_set_hardstate(self._h, group, term, vote, commit)
             self._pending = True
+            self._bytes += _HDR.size + _HARD.size
             return
         self._write(_HARD.pack(REC_HARDSTATE, group, term, vote, commit))
 
     def set_snapshot(self, group: int, index: int, term: int) -> None:
-        """Snapshot/compaction boundary marker: on replay, entries of
-        `group` at or below `index` are dropped and the log starts there
-        (with the boundary entry's term preserved)."""
+        """InstallSnapshot boundary marker: on replay, entries of `group`
+        at or below `index` AND the retained suffix are dropped — the
+        installed state's history supersedes the whole local log."""
+        self._active_stats.bump(group, index)
         if self._lib is not None:
             self._lib.wal_set_snapshot(self._h, group, index, term)
             self._pending = True
+            self._bytes += _HDR.size + _SNAP.size
             return
         self._write(_SNAP.pack(REC_SNAPSHOT, group, index, term))
+
+    def _write_compact_rec(self, group: int, index: int, term: int) -> None:
+        self._active_stats.bump(group, index)
+        if self._lib is not None:
+            self._lib.wal_set_compact(self._h, group, index, term)
+            self._pending = True
+            self._bytes += _HDR.size + _SNAP.size
+            return
+        self._write(_SNAP.pack(REC_COMPACT, group, index, term))
+
+    def mark_compact(self, group: int, index: int, term: int) -> None:
+        """Compaction floor marker: on replay, entries of `group` at or
+        below `index` are dropped; the suffix survives.  Idempotent per
+        floor (re-marking an already-marked floor is skipped)."""
+        if index <= self._marker_floor.get(group, 0):
+            return
+        self._marker_floor[group] = index
+        self._write_compact_rec(group, index, term)
 
     def sync(self) -> None:
         if not self._pending:
@@ -176,8 +312,23 @@ class WAL:
             self._f.flush()
             os.fsync(self._f.fileno())
         self._pending = False
+        if self._bytes >= self.segment_bytes:
+            self._rotate()
 
-    def close(self) -> None:
+    def _rotate(self) -> None:
+        """Close the active segment and start wal-<seq+1>.log.  Only ever
+        called at a sync boundary, so every closed segment is a complete,
+        durable record stream."""
+        self._close_handle()
+        self._closed_stats[self.path] = self._active_stats
+        self._active_stats = _SegStats()
+        self._seq += 1
+        self.path = os.path.join(self.dirname, f"wal-{self._seq}.log")
+        self._bytes = 0
+        self._open_active()
+        _fsync_dir(self.dirname)
+
+    def _close_handle(self) -> None:
         if self._lib is not None:
             lib, self._lib = self._lib, None
             rc = lib.wal_close(self._h)
@@ -187,54 +338,25 @@ class WAL:
                               "may be lost)")
             return
         if self._f is not None:
-            self.sync()
-            self._f.close()
+            f, self._f = self._f, None
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+
+    def close(self) -> None:
+        if self._lib is None and self._f is None:
+            return
+        self._close_handle()
+        self._pending = False
 
     # -- compaction ------------------------------------------------------
 
-    @staticmethod
-    def rewrite(dirname: str, groups: Dict[int, GroupLog]) -> None:
-        """Atomically replace the WAL with a compacted image.
-
-        `groups` is the desired post-compaction state: per group, a
-        snapshot boundary (start, start_term), the retained entry tail,
-        and the current hard state.  Written to a temp file, fsynced, then
-        renamed over the live WAL — a crash at any point leaves either the
-        old or the new WAL intact.  The caller must hold the WAL quiescent
-        (no concurrent appends) and reopen its handle afterwards.
-        """
-        path = os.path.join(dirname, WAL_FILE)
-        tmp = path + ".rewrite"
-        w = WAL.__new__(WAL)                      # bare python-backend WAL
-        w._lib = w._h = None
-        w.path = tmp
-        w._f = open(tmp, "wb")
-        w._pending = False
-        for g, gl in sorted(groups.items()):
-            if gl.start:
-                w.set_snapshot(g, gl.start, gl.start_term)
-            for i, (term, data) in enumerate(gl.entries):
-                w.append_entry(g, gl.start + 1 + i, term, data)
-            w.set_hardstate(g, gl.hard.term, gl.hard.vote, gl.hard.commit)
-        w.sync()
-        w.close()
-        os.replace(tmp, path)
-        # Durability of the rename itself.
-        dirfd = os.open(dirname, os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-
-    # -- replay ----------------------------------------------------------
-
-    @staticmethod
-    def replay(dirname: str) -> Dict[int, GroupLog]:
-        """Read the WAL back into per-group logs; tolerate a torn tail."""
-        groups: Dict[int, GroupLog] = {}
-        path = os.path.join(dirname, WAL_FILE)
-        if not os.path.isfile(path):
-            return groups
+    def _stats_for(self, path: str) -> _SegStats:
+        """Stats of a closed (immutable) segment, scanned once."""
+        st = self._closed_stats.get(path)
+        if st is not None:
+            return st
+        st = _SegStats()
         with open(path, "rb") as f:
             blob = f.read()
         off = 0
@@ -242,7 +364,154 @@ class WAL:
             crc, blen = _HDR.unpack_from(blob, off)
             body = blob[off + _HDR.size: off + _HDR.size + blen]
             if len(body) != blen or zlib.crc32(body) != crc:
-                break               # torn tail — drop the rest
+                break
+            off += _HDR.size + blen
+            rtype = body[0]
+            if rtype == REC_ENTRY:
+                _, group, index, _t = _ENTRY.unpack_from(body)
+                st.bump(group, index)
+            elif rtype == REC_HARDSTATE:
+                st.hs.add(_HARD.unpack_from(body)[1])
+            elif rtype in (REC_SNAPSHOT, REC_COMPACT):
+                _, group, index, _t = _SNAP.unpack_from(body)
+                st.bump(group, index)
+        self._closed_stats[path] = st
+        return st
+
+    def compact(self, floors: Dict[int, Tuple[int, int]],
+                hard: Dict[int, Tuple[int, int, int]]) -> int:
+        """Advance compaction floors and drop fully-superseded segments.
+
+        floors: {group: (floor_index, floor_term)} — the durable
+          snapshot-covered boundary per group (every group with a nonzero
+          payload-log start, not just newly compacted ones).
+        hard: {group: (term, vote, commit)} — current hard states, used
+          to re-assert state for groups whose only hardstate records live
+          in a segment being deleted.
+
+        Appends COMPACT markers for advanced floors, then walks closed
+        segments oldest-first and unlinks each whose every entry/marker
+        is at or below its group's floor (hardstate-only groups are
+        re-asserted into the active segment first).  Stops at the first
+        non-deletable segment to keep the segment sequence contiguous.
+        Never rewrites live data; cost is O(markers + unlinked files).
+
+        Returns the number of deleted segments.
+        """
+        wrote = False
+        for g, (idx, term) in sorted(floors.items()):
+            if idx > self._marker_floor.get(g, 0):
+                self.mark_compact(g, idx, term)
+                wrote = True
+        if wrote:
+            self.sync()
+
+        # Find the longest deletable prefix run first, then re-assert the
+        # UNION of its groups once and fsync once — a long run of small
+        # segments must not cost one fsync each (the caller holds the
+        # node's WAL lock across this).
+        run: List[str] = []
+        affected: Set[int] = set()
+        for seq, path in _segment_paths(self.dirname):
+            if path == self.path:
+                break                   # never delete the active segment
+            st = self._stats_for(path)
+            ok = all(
+                g in floors and idx <= floors[g][0]
+                for g, idx in st.max_idx.items()
+            ) and all(g in hard for g in st.hs - set(st.max_idx))
+            if not ok:
+                break
+            run.append(path)
+            affected |= st.groups()
+        if not run:
+            return 0
+        # Re-assert everything the doomed segments contributed, into the
+        # active segment, durably, BEFORE the unlinks: hard states
+        # (last-wins, and `hard` is current so appending it last is
+        # correct) and floor markers (replay must re-learn start).
+        for g in sorted(affected):
+            if g in hard:
+                self.set_hardstate(g, *hard[g])
+            if g in floors:
+                self._write_compact_rec(g, *floors[g])
+        self.sync()
+        for path in run:
+            os.unlink(path)
+            self._closed_stats.pop(path, None)
+        _fsync_dir(self.dirname)
+        return len(run)
+
+    @staticmethod
+    def rewrite(dirname: str, groups: Dict[int, GroupLog]) -> None:
+        """Atomically replace the WAL contents with a compacted image.
+
+        Writes the image as a NEW top segment (seq = max + 1), fsyncs it
+        into place, then unlinks all older segments.  A crash at any
+        point leaves a correct replay: before the rename the old segments
+        are intact; after it, replaying old segments then the image
+        yields exactly the image (SNAPSHOT markers + full retained tails
+        + final hard states supersede the prefix).  The caller must hold
+        the WAL quiescent (no concurrent appends) and reopen its handle
+        afterwards.
+
+        The live engine compacts with `compact` (markers + segment
+        drops); this full rewrite remains for offline tooling and tests.
+        """
+        segs = _segment_paths(dirname)
+        new_seq = (segs[-1][0] + 1) if segs else 0
+        path = os.path.join(dirname, f"wal-{new_seq}.log")
+        tmp = path + ".rewrite"
+        w = WAL.__new__(WAL)                      # bare python-backend WAL
+        w._lib = w._h = None
+        w.path = tmp
+        w._f = open(tmp, "wb")
+        w._pending = False
+        w._bytes = 0
+        w._active_stats = _SegStats()
+        for g, gl in sorted(groups.items()):
+            if gl.start:
+                w.set_snapshot(g, gl.start, gl.start_term)
+            for i, (term, data) in enumerate(gl.entries):
+                w.append_entry(g, gl.start + 1 + i, term, data)
+            w.set_hardstate(g, gl.hard.term, gl.hard.vote, gl.hard.commit)
+        w._f.flush()
+        os.fsync(w._f.fileno())
+        w._f.close()
+        os.replace(tmp, path)
+        _fsync_dir(dirname)
+        for seq, old in segs:
+            os.unlink(old)
+        if segs:
+            _fsync_dir(dirname)
+
+    # -- replay ----------------------------------------------------------
+
+    @staticmethod
+    def replay(dirname: str) -> Dict[int, GroupLog]:
+        """Read all segments back into per-group logs, sequence order.
+
+        A torn record drops everything after it — including later
+        segments: only the active segment's tail can be torn by a crash,
+        so a tear mid-sequence means real corruption and the safe replay
+        is the longest clean prefix."""
+        groups: Dict[int, GroupLog] = {}
+        for seq, path in _segment_paths(dirname):
+            with open(path, "rb") as f:
+                blob = f.read()
+            if not WAL._replay_blob(blob, groups):
+                break
+        return groups
+
+    @staticmethod
+    def _replay_blob(blob: bytes, groups: Dict[int, GroupLog]) -> bool:
+        """Apply one segment's records; False on a torn record."""
+        off = 0
+        while off + _HDR.size <= len(blob):
+            crc, blen = _HDR.unpack_from(blob, off)
+            body = blob[off + _HDR.size: off + _HDR.size + blen]
+            if len(body) != blen or zlib.crc32(body) != crc:
+                return False        # torn — drop the rest
             off += _HDR.size + blen
             rtype = body[0]
             if rtype == REC_ENTRY:
@@ -260,7 +529,17 @@ class WAL:
                         gl.entries.append((term, data))
                 elif pos == len(gl.entries) + 1:
                     gl.entries.append((term, data))
-                # else: a gap would mean WAL corruption; skip the record.
+                else:
+                    # Forward gap: the missing prefix lived in segments
+                    # compaction unlinked (its COMPACT marker replays
+                    # later, from a retained segment — it will confirm
+                    # this floor and supply start_term).  Record-level
+                    # corruption cannot produce a gap: appends are
+                    # sequential within a segment and a torn record stops
+                    # replay entirely.
+                    gl.entries.clear()
+                    gl.start, gl.start_term = index - 1, 0
+                    gl.entries.append((term, data))
             elif rtype == REC_HARDSTATE:
                 _, group, term, vote, commit = _HARD.unpack_from(body)
                 gl = groups.setdefault(group, GroupLog())
@@ -275,4 +554,17 @@ class WAL:
                 if index > gl.start:
                     gl.entries.clear()
                     gl.start, gl.start_term = index, term
-        return groups
+            elif rtype == REC_COMPACT:
+                _, group, index, term = _SNAP.unpack_from(body)
+                gl = groups.setdefault(group, GroupLog())
+                # Local compaction floor: the covered prefix goes, the
+                # retained suffix SURVIVES (unlike REC_SNAPSHOT).
+                if index > gl.start:
+                    drop = min(index - gl.start, len(gl.entries))
+                    del gl.entries[:drop]
+                    gl.start, gl.start_term = index, term
+                elif index == gl.start and gl.start_term == 0:
+                    # Confirms an implicit floor inferred from a forward
+                    # entry gap (see ENTRY handling above).
+                    gl.start_term = term
+        return True

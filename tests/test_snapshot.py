@@ -137,8 +137,12 @@ class TestClusterResume:
 
     def test_compaction_shrinks_wal_and_restarts(self, tmp_path):
         hub = LoopbackHub()
+        # Tiny segments so the 81-entry run rotates several times and
+        # compaction can drop whole pre-floor segments (VERDICT: no
+        # stop-the-world rewrite of live data).
         cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=TICK,
-                         log_window=16, max_entries_per_msg=4)
+                         log_window=16, max_entries_per_msg=4,
+                         wal_segment_bytes=2048)
         dbs = [_boot(tmp_path, hub, cfg, i, resume=True, compact_every=20)
                for i in range(3)]
         try:
@@ -150,11 +154,12 @@ class TestClusterResume:
             # At least one node compacted (keep clamps to log_window=16,
             # applied ~81 >> 16).
             assert any(db.metrics()["compactions"] > 0 for db in dbs)
-            walsz = os.path.getsize(
-                str(tmp_path / "raftsql-1" / "wal-0.log"))
-            # Un-compacted WAL of 81 inserts is >> 4 KB; compacted keeps
-            # the last <= ~16-entry window (plus hardstate).
-            assert walsz < 4096, walsz
+            segs = sorted((tmp_path / "raftsql-1").glob("wal-*.log"))
+            walsz = sum(os.path.getsize(s) for s in segs)
+            # Un-compacted the 81-insert log spans many 2 KiB segments;
+            # compaction must have unlinked the pre-floor ones.
+            assert walsz < 6144, (walsz, segs)
+            assert segs[0].name != "wal-0.log", segs   # oldest seg dropped
             # Restart a compacted node; it must come back consistent.
             dbs[0].close()
             dbs[0] = _boot(tmp_path, hub, cfg, 0, resume=True)

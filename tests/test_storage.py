@@ -79,6 +79,142 @@ class TestWAL:
         assert WAL.replay(str(tmp_path / "nope")) == {}
 
 
+class TestSegmentation:
+    """Segmented WAL: rotation at sync boundaries, replay concatenation,
+    compaction by whole-segment deletion (etcd/wal's segment-dir shape,
+    reference raft.go:99-117)."""
+
+    def test_rotation_and_replay(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=256)
+        for i in range(1, 41):
+            w.append_entry(0, i, 1, f"entry-{i:03d}".encode())
+            w.set_hardstate(0, 1, -1, i)
+            w.sync()
+        w.close()
+        segs = sorted(p.name for p in (tmp_path / "w").glob("wal-*.log"))
+        assert len(segs) > 2, segs           # actually rotated
+        gl = WAL.replay(d)[0]
+        assert gl.log_len == 40
+        assert [e[1] for e in gl.entries] == [
+            f"entry-{i:03d}".encode() for i in range(1, 41)]
+        assert gl.hard.commit == 40          # last hardstate wins
+
+    def test_reopen_appends_to_highest_segment(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=128)
+        for i in range(1, 11):
+            w.append_entry(0, i, 1, b"x" * 20)
+            w.sync()
+        w.close()
+        n_before = len(list((tmp_path / "w").glob("wal-*.log")))
+        w2 = WAL(d, segment_bytes=128)
+        w2.append_entry(0, 11, 1, b"after-reopen")
+        w2.sync()
+        w2.close()
+        assert len(list((tmp_path / "w").glob("wal-*.log"))) >= n_before
+        gl = WAL.replay(d)[0]
+        assert gl.log_len == 11
+        assert gl.entries[-1] == (1, b"after-reopen")
+
+    def test_compact_deletes_covered_segments(self, tmp_path):
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=256)
+        for i in range(1, 41):
+            w.append_entry(0, i, 2, f"e{i}".encode())
+            w.set_hardstate(0, 2, 0, i)
+            w.sync()
+        segs0 = sorted((tmp_path / "w").glob("wal-*.log"))
+        assert len(segs0) > 3
+        deleted = w.compact({0: (30, 2)}, {0: (2, 0, 40)})
+        assert deleted > 0
+        segs1 = sorted((tmp_path / "w").glob("wal-*.log"))
+        assert len(segs1) < len(segs0)
+        # Replay after dropping segments: floor honored, suffix intact.
+        w.close()
+        gl = WAL.replay(d)[0]
+        assert gl.start == 30
+        assert gl.start_term == 2
+        assert gl.log_len == 40
+        assert [e[1] for e in gl.entries] == [
+            f"e{i}".encode() for i in range(31, 41)]
+        assert gl.hard == type(gl.hard)(term=2, vote=0, commit=40)
+
+    def test_compact_never_deletes_uncovered(self, tmp_path):
+        """A segment holding entries above the floor must survive, and
+        so must everything after it (contiguity)."""
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=256)
+        for i in range(1, 41):
+            w.append_entry(0, i, 1, f"e{i}".encode())
+            w.sync()
+        deleted = w.compact({0: (5, 1)}, {0: (1, -1, 40)})
+        w.close()
+        gl = WAL.replay(d)[0]
+        assert gl.start == 5
+        assert gl.log_len == 40
+        assert [e[1] for e in gl.entries] == [
+            f"e{i}".encode() for i in range(6, 41)]
+
+    def test_compact_multi_group_blocks_on_uncompacted_group(self,
+                                                             tmp_path):
+        """A segment is only deletable when EVERY group's records in it
+        are covered; one lagging group pins it."""
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=200)
+        for i in range(1, 21):
+            w.append_entry(0, i, 1, f"a{i}".encode())
+            w.append_entry(1, i, 1, f"b{i}".encode())
+            w.sync()
+        # Only group 0 has a floor; group 1 pins every segment.
+        assert w.compact({0: (15, 1)}, {0: (1, -1, 20),
+                                        1: (1, -1, 20)}) == 0
+        # Give group 1 a floor too: early segments can go.
+        assert w.compact({0: (15, 1), 1: (15, 1)},
+                         {0: (1, -1, 20), 1: (1, -1, 20)}) > 0
+        w.close()
+        groups = WAL.replay(d)
+        assert groups[0].start == 15 and groups[1].start == 15
+        assert groups[0].log_len == 20 and groups[1].log_len == 20
+
+    def test_compact_marker_replay_keeps_suffix(self, tmp_path):
+        """REC_COMPACT drops only the covered prefix (REC_SNAPSHOT also
+        drops the suffix — different semantics, both replayed here)."""
+        d = str(tmp_path / "w")
+        w = WAL(d)
+        for i in range(1, 11):
+            w.append_entry(0, i, 1, f"e{i}".encode())
+        w.mark_compact(0, 4, 1)
+        w.append_entry(1, 1, 1, b"x1")
+        w.set_snapshot(1, 7, 3)              # install: suffix must go too
+        w.close()
+        groups = WAL.replay(d)
+        assert groups[0].start == 4
+        assert [e[1] for e in groups[0].entries] == [
+            f"e{i}".encode() for i in range(5, 11)]
+        assert groups[1].start == 7
+        assert groups[1].entries == []
+
+    def test_torn_mid_sequence_drops_later_segments(self, tmp_path):
+        """A tear in a non-final segment is real corruption: replay keeps
+        only the clean prefix, never skips over the damage."""
+        d = str(tmp_path / "w")
+        w = WAL(d, segment_bytes=64)
+        for i in range(1, 9):
+            w.append_entry(0, i, 1, b"y" * 30)
+            w.sync()
+        w.close()
+        segs = sorted((tmp_path / "w").glob("wal-*.log"))
+        assert len(segs) >= 3
+        # Corrupt the middle segment's first record.
+        mid = segs[len(segs) // 2]
+        blob = bytearray(mid.read_bytes())
+        blob[10] ^= 0xFF
+        mid.write_bytes(bytes(blob))
+        gl = WAL.replay(d)[0]
+        assert 0 < gl.log_len < 8
+
+
 class TestCodec:
     def test_roundtrip(self):
         batch = TickBatch(
