@@ -24,11 +24,17 @@ import dataclasses
 FOLLOWER = 0
 CANDIDATE = 1
 LEADER = 2
+PRECANDIDATE = 3
 
 # Message type codes (shared by the vote slot and the append slot).
 MSG_NONE = 0
 MSG_REQ = 1
 MSG_RESP = 2
+# Prevote (vote slot only): a timed-out peer probes for election viability
+# at term+1 WITHOUT bumping any term (raft §9.6 / etcd PreVote).  Codes
+# ride the same u8 wire field as MSG_REQ/MSG_RESP (transport/codec.py).
+MSG_PREREQ = 3
+MSG_PRERESP = 4
 
 # voted_for sentinel: no vote cast this term.
 NO_VOTE = -1
@@ -61,6 +67,21 @@ class RaftConfig:
     # ticks at 100ms; the batched engine defaults much faster because one
     # device step advances every group at once.
     tick_interval_s: float = 0.001
+
+    # PreVote (raft §9.6): a timed-out peer first probes a quorum at
+    # term+1 without bumping terms; only a successful probe starts a real
+    # election.  Keeps a partitioned peer's term from inflating, so its
+    # rejoin cannot depose a healthy leader.  The modern etcd/raft (the
+    # successor of the engine the reference vendors, raft.go:30) ships
+    # this; the 2015 vendored copy predates it.
+    prevote: bool = True
+
+    # Pipelined-replication window: how many optimistic AppendEntries
+    # batches may be in flight beyond a follower's acked match before the
+    # leader stalls and re-sends (core/step.py Phase 9).  The analog of
+    # the reference's MaxInflightMsgs: 256 (raft.go:158) — much smaller
+    # here because one "message" is an E-entry batch re-sent every tick.
+    max_inflight_msgs: int = 4
 
     # Commit-advance kernel: "point" (etcd's maybeCommit shortcut — check
     # only the quorum index), "windowed" (full masked scan of the ring,

@@ -35,7 +35,7 @@ class PeerState(NamedTuple):
 
     term: jax.Array          # [G] i32 current term
     voted_for: jax.Array     # [G] i32 peer voted for this term, NO_VOTE if none
-    role: jax.Array          # [G] i32 FOLLOWER / CANDIDATE / LEADER
+    role: jax.Array          # [G] i32 FOLLOWER/CANDIDATE/LEADER/PRECANDIDATE
     leader_hint: jax.Array   # [G] i32 last known leader, NO_LEADER if unknown
 
     commit: jax.Array        # [G] i32 highest committed log index
@@ -61,9 +61,10 @@ class PeerState(NamedTuple):
 class Inbox(NamedTuple):
     """Dense per-source message slots delivered to one peer.
 
-    Two slots per (group, source): a *vote* slot (RequestVote req/resp) and
-    an *append* slot (AppendEntries req/resp), distinguished by type codes
-    MSG_NONE / MSG_REQ / MSG_RESP.  This replaces the vendored etcd
+    Two slots per (group, source): a *vote* slot (RequestVote / PreVote
+    req/resp) and an *append* slot (AppendEntries req/resp), distinguished
+    by type codes MSG_NONE / MSG_REQ / MSG_RESP, plus — vote slot only —
+    MSG_PREREQ / MSG_PRERESP.  This replaces the vendored etcd
     `raftpb.Message` stream (reference raft.go:268-270) with fixed-width
     arrays that map directly onto device memory.
 
@@ -72,7 +73,7 @@ class Inbox(NamedTuple):
     """
 
     # Vote slot [G, P]:
-    v_type: jax.Array        # i32 MSG_NONE / MSG_REQ / MSG_RESP
+    v_type: jax.Array        # i32 MSG_NONE/MSG_REQ/MSG_RESP/MSG_PREREQ/MSG_PRERESP
     v_term: jax.Array        # i32 sender term
     v_last_idx: jax.Array    # i32 (req) candidate last log index
     v_last_term: jax.Array   # i32 (req) candidate last log term

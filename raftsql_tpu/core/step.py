@@ -34,8 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_NONE,
-                                MSG_REQ, MSG_RESP, NO_LEADER, NO_VOTE,
-                                RaftConfig)
+                                MSG_PREREQ, MSG_PRERESP, MSG_REQ, MSG_RESP,
+                                NO_LEADER, NO_VOTE, PRECANDIDATE, RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     term_at)
 from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
@@ -77,10 +77,16 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     commit0 = state.commit
 
     # ---- Phase 1: term catch-up.  Any message with a newer term makes us a
-    # follower of that term (raft §5.1).
-    v_has, a_has = inbox.v_type != MSG_NONE, inbox.a_type != MSG_NONE
+    # follower of that term (raft §5.1) — EXCEPT prevote traffic carrying a
+    # *probed* future term: PREREQ (the probe itself) and granted PRERESP
+    # (echoing the probed term back) must not bump anyone, or prevote would
+    # inflate terms exactly like the elections it prevents.  A REJECTED
+    # PRERESP carries the responder's real current term and does bump.
+    v_bump = (inbox.v_type == MSG_REQ) | (inbox.v_type == MSG_RESP) \
+        | ((inbox.v_type == MSG_PRERESP) & ~inbox.v_granted)
+    a_has = inbox.a_type != MSG_NONE
     msg_term = jnp.maximum(
-        jnp.max(jnp.where(v_has, inbox.v_term, 0), axis=-1),
+        jnp.max(jnp.where(v_bump, inbox.v_term, 0), axis=-1),
         jnp.max(jnp.where(a_has, inbox.a_term, 0), axis=-1))      # [G]
     bumped = msg_term > state.term
     term = jnp.maximum(state.term, msg_term)
@@ -106,7 +112,44 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     grant = eligible & (src_ids == grant_to[:, None])             # [G, P]
     voted = jnp.where(any_grant, grant_to, voted)
 
-    # ---- Phase 3: RequestVote responses → candidate tally → leadership.
+    # ---- Phase 2b: PreVote requests.  Grant iff the probe targets a term
+    # ahead of ours, the prober's log is up-to-date, and we are NOT inside
+    # a live leader's lease (heard from it within one election interval) —
+    # the lease test is what starves a partitioned prober while the
+    # cluster is healthy.  Prevote grants persist nothing (not voted_for),
+    # so any number may be granted per tick, one per source slot.
+    preq = inbox.v_type == MSG_PREREQ
+    if cfg.prevote:
+        in_lease = (leader_hint != NO_LEADER) & \
+            (state.elapsed < cfg.election_ticks)
+        pre_grant = preq & (inbox.v_term > term[:, None]) & up2date \
+            & ~in_lease[:, None]
+    else:
+        pre_grant = jnp.zeros_like(preq)
+
+    # Vote-slot responses must be stamped with the term their grant/reject
+    # was DECIDED at (here, before the Phase-3 prevote promotion can bump
+    # our term) — a grant decided at T but stamped T+1 would depose the
+    # very candidate it was granted to via the Phase-1 bump rule.
+    vterm_resp = term
+
+    # ---- Phase 3: vote tallies.  First the prevote tally (promotes
+    # PRECANDIDATE → CANDIDATE, bumping the term only now that a quorum
+    # said the election could win), then the real-vote tally — a just-
+    # promoted candidate holding its own vote can win leadership in the
+    # same tick when P == 1.
+    if cfg.prevote:
+        got_pre = (inbox.v_type == MSG_PRERESP) & inbox.v_granted \
+            & (inbox.v_term == term[:, None] + 1) \
+            & (role == PRECANDIDATE)[:, None]
+        votes = votes | got_pre
+        become_cand = (role == PRECANDIDATE) & (vote_count(votes) >= quorum)
+        term = jnp.where(become_cand, term + 1, term)
+        role = jnp.where(become_cand, CANDIDATE, role)
+        voted = jnp.where(become_cand, self_id, voted)
+        votes = jnp.where(become_cand[:, None],
+                          jnp.broadcast_to(self_onehot, (G, P)), votes)
+
     got_vote = (inbox.v_type == MSG_RESP) & (inbox.v_term == term[:, None]) \
         & inbox.v_granted & (role == CANDIDATE)[:, None]
     votes = votes | got_vote
@@ -124,7 +167,9 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     areq_cur = areq & (inbox.a_term == term[:, None])
     any_app = areq_cur.any(-1)
     asrc = jnp.argmax(areq_cur, axis=-1).astype(I32)              # [G]
-    role = jnp.where(any_app & (role == CANDIDATE), FOLLOWER, role)
+    role = jnp.where(
+        any_app & ((role == CANDIDATE) | (role == PRECANDIDATE)),
+        FOLLOWER, role)
     leader_hint = jnp.where(any_app, asrc, leader_hint)
 
     def pick(x):  # gather the chosen source's message fields → [G, ...]
@@ -221,11 +266,21 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     elapsed = jnp.where(is_leader | reset, 0, state.elapsed + 1)
     fire = (role != LEADER) & (elapsed >= state.timeout)
     term_resp = term          # term used in responses composed above
-    term = jnp.where(fire, term + 1, term)
-    role = jnp.where(fire, CANDIDATE, role)
-    voted = jnp.where(fire, self_id, voted)
-    votes = jnp.where(fire[:, None], jnp.broadcast_to(self_onehot, (G, P)),
-                      votes)
+    if cfg.prevote:
+        # Timeout starts a PROBE, not an election: role flips to
+        # PRECANDIDATE at the unchanged term, self-prevote is tallied,
+        # nothing is persisted.  The term bumps only in Phase 3 when a
+        # quorum grants the probe — so a partitioned peer can fire
+        # forever without inflating its term.
+        role = jnp.where(fire, PRECANDIDATE, role)
+        votes = jnp.where(fire[:, None],
+                         jnp.broadcast_to(self_onehot, (G, P)), votes)
+    else:
+        term = jnp.where(fire, term + 1, term)
+        role = jnp.where(fire, CANDIDATE, role)
+        voted = jnp.where(fire, self_id, voted)
+        votes = jnp.where(fire[:, None],
+                          jnp.broadcast_to(self_onehot, (G, P)), votes)
     leader_hint = jnp.where(fire, NO_LEADER, leader_hint)
     elapsed = jnp.where(fire, 0, elapsed)
     key = jax.random.fold_in(state.rng, state.tick)
@@ -249,13 +304,23 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
 
     is_cand = role == CANDIDATE
     cand_bcast = is_cand[:, None] & ~self_onehot
+    # Prevote probes broadcast at term+1 (the term an election WOULD use);
+    # prevote responses echo the probed term on grant (so the prober's
+    # tally can match it against term+1) and our real term on reject (so
+    # a stale prober catches up via the Phase-1 bump rule).
+    pre_bcast = (role == PRECANDIDATE)[:, None] & ~self_onehot
     o_v_type = jnp.where(cand_bcast, MSG_REQ,
-                         jnp.where(vreq, MSG_RESP, MSG_NONE))
+                         jnp.where(pre_bcast, MSG_PREREQ,
+                                   jnp.where(vreq, MSG_RESP,
+                                             jnp.where(preq, MSG_PRERESP,
+                                                       MSG_NONE))))
+    resp_term = jnp.where(pre_grant, inbox.v_term,
+                          jnp.broadcast_to(vterm_resp[:, None], (G, P)))
     o_v_term = jnp.where(cand_bcast, term[:, None],
-                         jnp.broadcast_to(term_resp[:, None], (G, P)))
+                         jnp.where(pre_bcast, term[:, None] + 1, resp_term))
     o_v_last_idx = jnp.broadcast_to(log_len[:, None], (G, P))
     o_v_last_term = jnp.broadcast_to(my_last_term2[:, None], (G, P))
-    o_v_granted = grant & ~cand_bcast
+    o_v_granted = (grant | pre_grant) & ~cand_bcast & ~pre_bcast
 
     # Append responses (to every append request seen, incl. stale-term ones
     # so old leaders step down).
@@ -293,6 +358,31 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         + jnp.arange(E, dtype=I32)[None, None, :]                 # [G, P, E]
     ents_s = term_at(log_term, log_len,
                      ent_pos_s.reshape(G, P * E), W).reshape(G, P, E)
+
+    # Pipelined replication (etcd's optimistic sendAppend): advance
+    # next_idx past the entries just sent instead of idling an ack round
+    # trip — successive ticks then stream DISJOINT batches, so per-group
+    # throughput is E entries/tick, not E per RTT, and the propose→commit
+    # queue never builds to the flow-control ceiling.  A lost message
+    # surfaces as a reject whose conflict hint walks next_idx back
+    # (Phase 5), exactly as for any stale next_idx.
+    #
+    # The advance is capped at max_inflight_msgs batches beyond the
+    # follower's acked match (the reference's MaxInflightMsgs window,
+    # raft.go:158).  Without the cap, a follower ticking slower than its
+    # leader under the newest-wins inbox slot would see only every other
+    # (disjoint) batch and reject forever — capped, the leader stalls at
+    # the window edge and re-sends the SAME batch each tick until an ack
+    # drains it, which a slow follower always eventually processes.
+    # maximum(): the cap may sit below a next_idx already learned from a
+    # reject hint — stall (never regress) rather than re-send entries the
+    # follower already acknowledged holding.
+    inflight_cap = match + 1 + cfg.max_inflight_msgs * E
+    next_idx = jnp.where(send_app & (n_s > 0),
+                         jnp.maximum(next_idx,
+                                     jnp.minimum(prev_s + n_s + 1,
+                                                 inflight_cap)),
+                         next_idx)
 
     o_a_type = jnp.where(send_app, MSG_REQ,
                          jnp.where(areq, MSG_RESP, MSG_NONE))

@@ -25,7 +25,7 @@ from typing import Callable, List, Optional, Protocol, Sequence
 @dataclass
 class VoteRec:
     group: int
-    type: int           # MSG_REQ / MSG_RESP
+    type: int           # MSG_REQ / MSG_RESP / MSG_PREREQ / MSG_PRERESP
     term: int
     last_idx: int = 0   # request fields
     last_term: int = 0

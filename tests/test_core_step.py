@@ -182,8 +182,9 @@ class TestLaggedFollower:
                - np.asarray(states.log_len)[lag])
         assert (gap > cfg.log_window).all(), gap
 
-        # Heal.  The rejoining follower's inflated term may depose the
-        # leader ONCE (no prevote); after that the cluster must settle.
+        # Heal.  With prevote the rejoining follower's term never
+        # inflated, so no deposal happens at all; either way the cluster
+        # must settle to one stable leader with no further term churn.
         zero = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
         for _ in range(80):
             states, inboxes, _ = cluster_step(cfg, states, inboxes, zero)
@@ -194,6 +195,52 @@ class TestLaggedFollower:
         final_term = np.asarray(states.term).max(axis=0)
         assert (final_term == settled_term).all(), (
             f"terms churned after settling: {settled_term} -> {final_term}")
+        assert (leaders_per_group(states, cfg) == 1).all()
+
+
+class TestPrevote:
+    def test_partitioned_rejoin_zero_deposal(self):
+        """A follower partitioned past many election timeouts must NOT
+        depose the live leader on rejoin: prevote (raft §9.6) pins its
+        term while its probes cannot reach a quorum, so the rejoin finds
+        it at the cluster's own term with nothing to offer."""
+        cfg = small_cfg(num_groups=4, seed=9)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 100)
+        assert (leaders_per_group(states, cfg) == 1).all()
+        role = np.asarray(states.role)
+        lag = 2
+        fg = np.nonzero(role[lag] != LEADER)[0]   # groups peer 2 follows
+        assert fg.size, "seed must leave peer 2 a follower somewhere"
+        term_before = np.asarray(states.term).max(axis=0).copy()
+        lead_before = (role == LEADER).argmax(axis=0)
+        zero = jnp.zeros((cfg.num_peers, cfg.num_groups), jnp.int32)
+        # ~6 election timeouts of isolation: plenty of probe attempts.
+        for _ in range(120):
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, zero)
+            inboxes = isolate_peer(inboxes, lag)
+        # The partitioned peer's term must not have inflated.
+        assert (np.asarray(states.term)[lag, fg]
+                <= term_before[fg]).all(), np.asarray(states.term)[lag]
+        # Heal.  Zero deposal: same leader, same term, immediately stable.
+        for _ in range(60):
+            states, inboxes, _ = cluster_step(cfg, states, inboxes, zero)
+        role2 = np.asarray(states.role)
+        term_after = np.asarray(states.term).max(axis=0)
+        lead_after = (role2 == LEADER).argmax(axis=0)
+        assert (term_after[fg] == term_before[fg]).all(), (
+            f"terms inflated across rejoin: {term_before} -> {term_after}")
+        assert (lead_after[fg] == lead_before[fg]).all(), (
+            f"leader deposed by rejoin: {lead_before} -> {lead_after}")
+        assert (leaders_per_group(states, cfg) == 1).all()
+
+    def test_prevote_disabled_matches_legacy(self):
+        """prevote=False keeps the original fire→candidate behavior."""
+        cfg = small_cfg(prevote=False, seed=4)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        states, inboxes, _ = run_ticks(cfg, states, inboxes, 100)
         assert (leaders_per_group(states, cfg) == 1).all()
 
 
