@@ -24,6 +24,15 @@ vet:
 bench:
 	$(PY) bench.py
 
+# ThreadSanitizer pass over the native WAL's locking (SURVEY.md §5.2):
+# 4 threads x appends/hardstate/compact/snapshot/sync on one handle.
+tsan:
+	g++ -O1 -g -std=c++17 -fsanitize=thread -fPIC \
+	    -o /tmp/wal_stress_tsan \
+	    raftsql_tpu/native/wal_stress.cc raftsql_tpu/native/wal.cc
+	rm -rf /tmp/wal_tsan_dir && mkdir -p /tmp/wal_tsan_dir
+	/tmp/wal_stress_tsan /tmp/wal_tsan_dir 2000
+
 clean:
 	rm -f test.out raftsql_tpu/native/_native_*.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -16,12 +16,17 @@ percentiles and reported as a censored count.
 Prints exactly one JSON line on stdout and ALWAYS exits 0:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "platform": ...}
 
-Robustness model (the round-1 failure was rc=1/rc=124 with no number at
-all): the process runs as a PARENT that never imports a jax backend.  Each
-attempt is a CHILD subprocess under a hard timeout — first on the default
-platform (the remote-TPU "axon" tunnel when alive), then pinned to cpu.  A
-wedged or UNAVAILABLE tunnel therefore costs one bounded timeout and the
-driver still gets a real measured number from the cpu attempt.
+Robustness model (round 1 died on backend init, round 2 on one monolithic
+G=100k attempt): the process runs as a PARENT that never imports a jax
+backend.  Every measurement is a CHILD subprocess under a hard timeout.
+The parent first PROBES the default platform with a short timeout (a
+wedged remote-TPU tunnel hangs device init indefinitely), then — if the
+probe says tpu — runs a G-ladder (1k → 10k → 100k) smallest-first with
+per-shape fault capture and a second pass over failed shapes, keeping the
+largest succeeding shape as the headline.  A durable-path child (real
+RaftNode cluster: WAL + KV apply + loopback transport) runs on cpu, and a
+cpu headline is the last-resort fallback.  Exit code is ALWAYS 0 with one
+JSON line on stdout.
 
 The reference (chzchzchz/raftsql) publishes no numbers (BASELINE.md); the
 baseline used for `vs_baseline` is the driver-set north star of 1e8
@@ -29,10 +34,14 @@ commits/sec (100k groups x 1k proposals/sec each, BASELINE.json).
 
 Environment knobs:
   BENCH_CONFIG   headline | quorum | elections | commit_scan | multichip
-                 | all          (default headline)
+                 | rules | latency | durable | all    (default headline)
   BENCH_GROUPS / BENCH_PEERS / BENCH_TICKS / BENCH_REPEATS
+  BENCH_LADDER   comma-separated group counts   (default 1000,10000,100000)
   BENCH_PLATFORM cpu|tpu        (parent: single attempt on this platform)
   BENCH_ATTEMPT_TIMEOUT_S       (default 420, per child attempt)
+  BENCH_PROBE_TIMEOUT_S         (default 150, platform probe)
+  BENCH_TOTAL_BUDGET_S          (default 2400, whole-parent wall budget)
+  BENCH_SKIP_DURABLE=1 / BENCH_SKIP_SWEEP=1
   BENCH_PROFILE  <dir>          (wrap timed runs in jax.profiler.trace)
 """
 from __future__ import annotations
@@ -114,8 +123,14 @@ def make_bench_run(cfg, num_ticks: int):
 
 
 def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
-                     saturate: bool = True) -> float:
-    """Commits/sec for a G x P fused cluster under saturating load."""
+                     load: int | None = None, commit_rule: str = "point",
+                     stats: dict | None = None):
+    """Commits/sec + measured latency for a G x P fused cluster.
+
+    `load` = proposals submitted per group per tick (None = saturating,
+    i.e. max_entries_per_msg).  Returns best commits/s; if `stats` is
+    given, records {"p50_ms", "p99_ms", "tick_ms"} of the best repeat.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -124,13 +139,15 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
                                           init_cluster_state)
 
     cfg = RaftConfig(num_groups=groups, num_peers=peers, log_window=64,
-                     max_entries_per_msg=8, tick_interval_s=0.0)
+                     max_entries_per_msg=8, tick_interval_s=0.0,
+                     commit_rule=commit_rule)
     # Build the initial state ON device in one compiled program — at 100k
     # groups the eager per-leaf host->device transfers are the slow (and,
     # through a remote-device tunnel, fragile) path.
     states, inboxes = jax.jit(
         lambda: (init_cluster_state(cfg), empty_cluster_inbox(cfg)))()
-    load = cfg.max_entries_per_msg if saturate else 0
+    saturate = load is None
+    load = cfg.max_entries_per_msg if saturate else load
     full = jnp.full((cfg.num_peers, cfg.num_groups), load, jnp.int32)
 
     run = make_bench_run(cfg, ticks)
@@ -141,8 +158,9 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
     states, inboxes, c, _, _ = run(states, inboxes, full)
     jax.block_until_ready(c)
 
-    best, best_p50, best_p99 = 0.0, float("inf"), float("inf")
+    best, best_p50, best_p99, best_tick = 0.0, float("inf"), float("inf"), 0.0
     total_committed = 0
+    label = "saturated" if saturate else f"load={load}/group/tick"
     for _ in range(repeats):
         t0 = time.perf_counter()
         with _profiled():
@@ -161,7 +179,7 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
                        f"{float(pct[1]):.0f} ticks x {tick_ms:.4f} ms/tick, "
                        f"{groups - n_ok} censored)")
             if p50 < best_p50:
-                best_p50, best_p99 = p50, p99
+                best_p50, best_p99, best_tick = p50, p99, tick_ms
         else:
             lat_msg = "latency n/a (no group committed the marked batch)"
         _log(f"  {committed} commits in {dt:.3f}s -> {rate:,.0f} commits/s "
@@ -171,8 +189,29 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
         raise RuntimeError("benchmark committed nothing — engine stalled")
     if best_p50 < float("inf"):
         _log(f"  best: {best:,.0f} commits/s, measured propose->commit "
-             f"p50={best_p50:.3f} ms p99={best_p99:.3f} ms (saturated load)")
+             f"p50={best_p50:.3f} ms p99={best_p99:.3f} ms ({label})")
+    if stats is not None:
+        stats["p50_ms"] = round(best_p50, 3)
+        stats["p99_ms"] = round(best_p99, 3)
+        stats["tick_ms"] = round(best_tick, 4)
     return best
+
+
+def bench_latency_sweep(groups: int, peers: int, repeats: int) -> dict:
+    """Propose→commit latency at light / half / saturating load.
+
+    VERDICT r2 task 3: the <2ms p50 target (BASELINE.md) is a latency
+    target, and a saturated-only benchmark measures queueing, not the
+    engine floor.  Reports {load_label: {p50_ms, p99_ms, tick_ms}}.
+    """
+    sweep = {}
+    ticks = 32          # latency crossings happen in the first few ticks
+    for label, load in (("light_1", 1), ("half_4", 4), ("sat_8", None)):
+        _log(f"== latency @ {label} (G={groups}) ==")
+        st: dict = {}
+        bench_throughput(groups, peers, ticks, repeats, load=load, stats=st)
+        sweep[label] = st
+    return sweep
 
 
 def bench_elections(groups: int, peers: int, repeats: int) -> float:
@@ -314,9 +353,127 @@ def bench_multichip(ticks: int, repeats: int) -> float:
     return best
 
 
-def run_config(config: str, cpu: bool) -> float:
+def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
+    """The DURABLE product path: a real in-process RaftNode cluster —
+    WAL fsync before send before publish (reference raft.go:227-235),
+    loopback transport, KV apply — manually ticked in lockstep.
+
+    VERDICT r2 task 2: the device-only headline skips the host runtime;
+    this config measures what a user of the full framework gets.  Load
+    is pre-queued (E per group per tick) so the feeder isn't timed.
+    """
+    import shutil
+    import tempfile
+
+    from raftsql_tpu.config import RaftConfig
+    from raftsql_tpu.models.kv_sm import KVStateMachine
+    from raftsql_tpu.runtime.node import RaftNode
+    from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+
+    E = 8
+    cfg = RaftConfig(num_groups=groups, num_peers=peers, log_window=64,
+                     max_entries_per_msg=E, tick_interval_s=0.0)
+    tmp = tempfile.mkdtemp(prefix="bench-durable-")
+    hub = LoopbackHub(codec=False)
+    nodes = [RaftNode(i + 1, peers, cfg, LoopbackTransport(hub),
+                      os.path.join(tmp, f"n{i + 1}")) for i in range(peers)]
+    sms = [KVStateMachine() for _ in range(groups)]     # node-1's replicas
+    applied = 0
+
+    def drain(n0: "RaftNode", apply: bool) -> int:
+        cnt = 0
+        while True:
+            try:
+                item = n0.commit_q.get_nowait()
+            except Exception:
+                return cnt
+            if item is None or not isinstance(item, tuple):
+                continue
+            g, idx, cmd = item
+            if apply:
+                sms[g].apply(cmd, idx)
+            cnt += 1
+
+    try:
+        for n in nodes:
+            n.start(threaded=False)
+        # Elect every group: tick all nodes until each has a leader.
+        import numpy as np
+        for t in range(40 * cfg.election_ticks):
+            for n in nodes:
+                n.tick()
+            hints = np.asarray(nodes[0].state.leader_hint)
+            if t > cfg.election_ticks and (hints >= 0).all():
+                break
+        hints = np.asarray(nodes[0].state.leader_hint)
+        _log(f"  elected: {int((hints >= 0).sum())}/{groups} groups "
+             f"after warmup")
+        for n in nodes:     # drop compile/warmup skew from phase averages
+            m = n.metrics
+            m.ticks = 0
+            m.t_device_ms = m.t_wal_ms = m.t_send_ms = m.t_publish_ms = 0.0
+        best = 0.0
+        for _ in range(repeats):
+            # Pre-queue ticks*E proposals per group at its leader.
+            cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
+            for g in range(groups):
+                h = int(hints[g])
+                nodes[h if h >= 0 else 0].propose_many(g, cmds)
+            drain(nodes[0], apply=False)        # discard warmup commits
+            t0 = time.perf_counter()
+            committed = 0
+            for _ in range(ticks):
+                for n in nodes:
+                    n.tick()
+                committed += drain(nodes[0], apply=True)
+            dt = time.perf_counter() - t0
+            rate = committed / dt
+            m = nodes[0].metrics.snapshot()
+            _log(f"  {committed} durable commits in {dt:.3f}s -> "
+                 f"{rate:,.0f} commits/s ({dt / ticks * 1e3:.2f} ms/tick); "
+                 f"phase_ms={m['phase_ms_per_tick']}")
+            best = max(best, rate)
+        phase = nodes[0].metrics.snapshot()["phase_ms_per_tick"]
+        return best, {"durable_phase_ms": phase,
+                      "durable_tick_ms": round(sum(phase.values()), 3)}
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_rules_race(groups: int, peers: int, ticks: int, repeats: int
+                     ) -> dict:
+    """Race the three commit-advance kernels at the same shape.
+
+    VERDICT r2 task 6: `point` (etcd maybeCommit shortcut), `windowed`
+    (masked ring scan) and `pallas` (hand-written kernel) have never been
+    compared compiled; each is its own jit (commit_rule is static config).
+    """
+    out = {}
+    for rule in ("point", "windowed", "pallas"):
+        _log(f"== commit_rule={rule} (G={groups}) ==")
+        try:
+            out[rule] = round(
+                bench_throughput(groups, peers, ticks, repeats,
+                                 commit_rule=rule), 1)
+        except Exception as e:                      # noqa: BLE001
+            _log(f"  commit_rule={rule} FAILED: {type(e).__name__}: {e}")
+            out[rule] = f"fault: {type(e).__name__}"
+    _log(f"rules race: {out}")
+    return out
+
+
+def run_config(config: str, cpu: bool):
     """Dispatch one BENCH_CONFIG; defaults scale down on cpu so the
-    fallback path still finishes inside the driver's time budget."""
+    fallback path still finishes inside the driver's time budget.
+
+    Returns (headline_value, extras_dict) — extras are merged into the
+    child's JSON line for the driver/judge to record.
+    """
     groups = int(os.environ.get("BENCH_GROUPS", 4096 if cpu else 100_000))
     peers = int(os.environ.get("BENCH_PEERS", 3))
     ticks = int(os.environ.get("BENCH_TICKS", 120 if cpu else 400))
@@ -338,16 +495,36 @@ def run_config(config: str, cpu: bool) -> float:
         results["headline"] = bench_throughput(groups, peers, ticks, repeats)
         for k, v in results.items():
             _log(f"{k}: {v:,.0f}/s")
-        return results["headline"]
+        return results["headline"], {}
     if config == "quorum":
-        return bench_throughput(1000, 3, ticks, repeats)
+        return bench_throughput(1000, 3, ticks, repeats), {}
     if config == "elections":
-        return bench_elections(egroups, 5, repeats)
+        return bench_elections(egroups, 5, repeats), {}
     if config == "commit_scan":
-        return bench_commit_scan(groups, repeats)
+        return bench_commit_scan(groups, repeats), {}
     if config == "multichip":
-        return bench_multichip(ticks, repeats)
-    return bench_throughput(groups, peers, ticks, repeats)
+        return bench_multichip(ticks, repeats), {}
+    if config == "rules":
+        out = bench_rules_race(groups, peers, ticks, repeats)
+        vals = [v for v in out.values() if isinstance(v, float)]
+        return (max(vals) if vals else 0.0), {"rules": out}
+    if config == "latency":
+        sweep = bench_latency_sweep(groups, peers, repeats)
+        return sweep.get("light_1", {}).get("p50_ms", 0.0), {"lat": sweep}
+    if config == "durable":
+        dg = int(os.environ.get("BENCH_GROUPS", 1000 if cpu else 10_000))
+        dticks = int(os.environ.get("BENCH_TICKS", 24))
+        return bench_durable(dg, peers, dticks, min(repeats, 2))
+    # headline: saturated throughput + the latency/load sweep.
+    stats: dict = {}
+    value = bench_throughput(groups, peers, ticks, repeats, stats=stats)
+    extras = {"p50_sat_ms": stats.get("p50_ms"),
+              "tick_ms": stats.get("tick_ms")}
+    if os.environ.get("BENCH_SKIP_SWEEP") != "1":
+        sweep = bench_latency_sweep(groups, peers, max(1, repeats - 1))
+        extras["lat"] = sweep
+        extras["p50_light_ms"] = sweep.get("light_1", {}).get("p50_ms")
+    return value, extras
 
 
 def child_main() -> None:
@@ -360,17 +537,47 @@ def child_main() -> None:
         # captured from the env; update the live config.
         jax.config.update("jax_platforms", want)
     config = os.environ.get("BENCH_CONFIG", "headline")
-    platform = jax.devices()[0].platform
-    _log(f"bench[{config}]: platform={platform} "
+    backend = jax.devices()[0].platform
+    # The "axon" backend IS the remote TPU (a PJRT tunnel to one chip);
+    # report it as tpu, keeping the raw backend name alongside.
+    platform = "tpu" if backend == "axon" else backend
+    _log(f"bench[{config}]: platform={platform} backend={backend} "
          f"devices={len(jax.devices())}")
-    value = run_config(config, cpu=platform == "cpu")
-    print(json.dumps({
-        "metric": "raft_commits_per_sec",
-        "value": round(value, 1),
-        "unit": "commits/s",
-        "vs_baseline": round(value / NORTH_STAR_COMMITS_PER_SEC, 4),
-        "platform": platform,
-    }))
+    got = run_config(config, cpu=platform == "cpu")
+    value, extras = got if isinstance(got, tuple) else (got, {})
+    if config == "latency":
+        # Latency headline: ms, lower is better; vs_baseline is the
+        # ratio to the <2ms p50 north star (>=1 means target met).
+        out = {
+            "metric": "raft_propose_commit_p50_ms",
+            "value": round(value, 3),
+            "unit": "ms",
+            "vs_baseline": round(2.0 / value, 4) if value > 0 else 0.0,
+            "platform": platform,
+            "backend": backend,
+        }
+    else:
+        out = {
+            "metric": "raft_commits_per_sec",
+            "value": round(value, 1),
+            "unit": "commits/s",
+            "vs_baseline": round(value / NORTH_STAR_COMMITS_PER_SEC, 4),
+            "platform": platform,
+            "backend": backend,
+        }
+    out.update(extras)
+    print(json.dumps(out))
+
+
+def probe_main() -> None:
+    """Tiny child: report the default platform (and that it can compute)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    platform = "tpu" if d.platform == "axon" else d.platform
+    print(json.dumps({"probe": platform, "devices": len(jax.devices())}))
 
 
 # ---------------------------------------------------------------------------
@@ -378,59 +585,170 @@ def child_main() -> None:
 # ---------------------------------------------------------------------------
 
 
-def _attempt(platform: str, timeout_s: float) -> str | None:
-    """Run one child attempt; return its JSON line or None."""
-    env = dict(os.environ, BENCH_CHILD="1")
+def _attempt(platform: str, timeout_s: float, extra_env: dict | None = None,
+             label: str = "", mode: str = "1") -> dict | None:
+    """Run one child attempt; return its parsed JSON dict or None.
+
+    Failures are RECORDED, not fatal: the returncode / timeout / missing
+    JSON is logged per attempt so a device fault at one ladder shape
+    localizes instead of erasing the round's evidence."""
+    env = dict(os.environ, BENCH_CHILD=mode)
     if platform:
         env["BENCH_PLATFORM"] = platform
         # Must also be in the env BEFORE the child's sitecustomize imports
         # jax — the in-child config.update alone is a no-op if anything
         # initializes a backend at import time.
         env["JAX_PLATFORMS"] = platform
-    label = platform or "default"
-    _log(f"bench parent: attempt on platform={label} "
-         f"(timeout {timeout_s:.0f}s)")
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    label = label or platform or "default"
+    _log(f"bench parent: attempt[{label}] (timeout {timeout_s:.0f}s)")
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, stdout=subprocess.PIPE, text=True,
                            timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        _log(f"bench parent: attempt[{label}] timed out")
+        _log(f"bench parent: attempt[{label}] TIMED OUT after "
+             f"{timeout_s:.0f}s")
         return None
     for line in reversed((r.stdout or "").strip().splitlines()):
         try:
             parsed = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(parsed, dict) and "metric" in parsed:
-            return line
+        if isinstance(parsed, dict) and ("metric" in parsed
+                                         or "probe" in parsed):
+            return parsed
     _log(f"bench parent: attempt[{label}] rc={r.returncode}, no JSON")
     return None
 
 
+def _emit(parsed: dict) -> None:
+    print(json.dumps(parsed))
+
+
 def main() -> None:
+    """Parent: fault-localizing attempt ladder, guaranteed JSON + exit 0.
+
+    Plan (VERDICT r2 task 1):
+      1. Probe the default platform (the remote-TPU tunnel) with a SHORT
+         timeout — a wedged tunnel hangs device init indefinitely, and
+         burning the full attempt budget on it erased round 2's evidence.
+      2. If the probe says tpu: run the G-ladder smallest-first
+         (1k → 10k → 100k), each shape its own bounded child; retry
+         failed shapes in a second pass; headline = largest success.
+      3. Durable-path child on cpu (host-runtime benchmark, not device).
+      4. If no TPU result at all: cpu fallback for the headline.
+    """
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "420"))
     pinned = os.environ.get("BENCH_PLATFORM", "")
-    # With an explicit platform: one attempt. Otherwise: default backend
-    # (TPU when the tunnel is alive) first, cpu as the fallback.
-    plans = [pinned] if pinned else ["", "cpu"]
-    for platform in plans:
-        line = _attempt(platform, timeout_s)
-        if line:
-            print(line)
+    if pinned:
+        parsed = _attempt(pinned, timeout_s)
+        if parsed:
+            _emit(parsed)
             return
+        _log("bench parent: pinned attempt failed")
+        _emit({"metric": "raft_commits_per_sec", "value": 0.0,
+               "unit": "commits/s", "vs_baseline": 0.0, "platform": "none"})
+        return
+
+    # Overall wall budget: without it, a live-but-degraded tunnel that
+    # times out EVERY ladder child would stretch the serial plan past the
+    # driver's own deadline and reproduce the round-1 rc=124/no-JSON
+    # failure.  The fallback reserve guarantees the cpu headline always
+    # has room to run.
+    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
+    t_start = time.monotonic()
+    fallback_reserve = timeout_s + 90
+
+    def remaining() -> float:
+        return budget_s - (time.monotonic() - t_start)
+
+    # -- 1. platform probe (twice: the tunnel can flake transiently).
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
+    probe = None
+    for i in range(2):
+        probe = _attempt("", probe_timeout, label=f"probe{i}", mode="probe")
+        if probe:
+            break
+    platform = (probe or {}).get("probe", "none")
+    _log(f"bench parent: default platform = {platform}")
+
+    ladder_env = os.environ.get("BENCH_LADDER", "1000,10000,100000")
+    ladder = [int(x) for x in ladder_env.split(",") if x]
+    results: dict = {}
+    faults: dict = {}
+    if probe and platform not in ("cpu", "none"):
+        # -- 2. TPU G-ladder, two passes, smallest shape first.
+        for pass_no in range(2):
+            for G in ladder:
+                if G in results:
+                    continue
+                if remaining() < fallback_reserve + 60:
+                    faults.setdefault(G, []).append(
+                        f"pass{pass_no}:budget-exhausted")
+                    continue
+                got = _attempt(
+                    "", min(timeout_s, remaining() - fallback_reserve),
+                    extra_env={"BENCH_GROUPS": G,
+                               "BENCH_TICKS": os.environ.get(
+                                   "BENCH_TICKS", "400")},
+                    label=f"tpu-G{G}-p{pass_no}")
+                if got and got.get("value", 0) > 0:
+                    results[G] = got
+                else:
+                    faults.setdefault(G, []).append(
+                        f"pass{pass_no}:"
+                        + ("no-json-or-crash" if got is None else "zero"))
+            if len(results) == len(ladder):
+                break
+        _log(f"bench parent: ladder results "
+             f"{ {g: round(r['value'], 1) for g, r in results.items()} } "
+             f"faults {faults}")
+
+    # -- 3. durable-path child (host runtime measured on cpu).
+    durable = None
+    if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
+            and remaining() > fallback_reserve + 120:
+        durable = _attempt(
+            "cpu", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "durable"},
+            label="durable-cpu")
+
+    if results:
+        bestG = max(results)
+        parsed = results[bestG]
+        parsed["ladder"] = {
+            str(g): (round(results[g]["value"], 1) if g in results
+                     else "fault: " + ";".join(faults.get(g, ["?"])))
+            for g in ladder}
+        if durable:
+            parsed["durable_commits_per_s"] = durable.get("value")
+            parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
+        _emit(parsed)
+        return
+
+    # -- 4. cpu fallback headline.
+    _log("bench parent: no TPU result; falling back to cpu headline")
+    parsed = _attempt("cpu", max(min(timeout_s, remaining() - 30), 120))
+    if parsed:
+        if faults:
+            parsed["tpu_faults"] = {str(g): v for g, v in faults.items()}
+        if durable:
+            parsed["durable_commits_per_s"] = durable.get("value")
+            parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
+        _emit(parsed)
+        return
     _log("bench parent: all attempts failed")
-    print(json.dumps({
-        "metric": "raft_commits_per_sec",
-        "value": 0.0,
-        "unit": "commits/s",
-        "vs_baseline": 0.0,
-        "platform": "none",
-    }))
+    _emit({"metric": "raft_commits_per_sec", "value": 0.0,
+           "unit": "commits/s", "vs_baseline": 0.0, "platform": "none"})
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD"):
+    mode = os.environ.get("BENCH_CHILD")
+    if mode == "probe":
+        probe_main()
+    elif mode:
         child_main()
     else:
         main()

@@ -182,7 +182,15 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     a_ents = pick(inbox.a_ents)                                   # [G, E]
     a_commit = pick(inbox.a_commit)
 
-    prev_ok = (prev == 0) | ((prev <= log_len)
+    # Log-matching check — but ONLY for positions the ring can still
+    # verify: term_at() for prev <= log_len - W reads a slot now owned by
+    # a newer entry (ring aliasing), and a stale append (old leader, or
+    # one raced by an InstallSnapshot that cleared the ring) whose
+    # prev_term happens to equal the aliased slot would be falsely
+    # accepted — conflict-truncating a log it never matched.  Out-of-ring
+    # prev is rejected instead; the sender's walkback then lands on host
+    # catch-up or a snapshot, which is the correct path for that gap.
+    prev_ok = (prev == 0) | ((prev <= log_len) & (prev > log_len - W)
                              & (term_at(log_term, log_len, prev, W) == prev_t))
     accept = any_app & prev_ok & (role != LEADER)
 
@@ -308,19 +316,28 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # prevote responses echo the probed term on grant (so the prober's
     # tally can match it against term+1) and our real term on reject (so
     # a stale prober catches up via the Phase-1 bump rule).
+    # Responses OUTRANK the probe broadcast in a contended slot: when two
+    # precandidates probe each other, each must answer the other's probe
+    # (the probe to that peer re-sends next tick — and a granted answer
+    # promotes both, breaking the tie through a real election).  If the
+    # probe instead clobbered the response, three simultaneous
+    # precandidates would starve forever: a probe can only be answered by
+    # a non-probing peer, and none remains.
     pre_bcast = (role == PRECANDIDATE)[:, None] & ~self_onehot
     o_v_type = jnp.where(cand_bcast, MSG_REQ,
-                         jnp.where(pre_bcast, MSG_PREREQ,
-                                   jnp.where(vreq, MSG_RESP,
-                                             jnp.where(preq, MSG_PRERESP,
+                         jnp.where(vreq, MSG_RESP,
+                                   jnp.where(preq, MSG_PRERESP,
+                                             jnp.where(pre_bcast, MSG_PREREQ,
                                                        MSG_NONE))))
     resp_term = jnp.where(pre_grant, inbox.v_term,
                           jnp.broadcast_to(vterm_resp[:, None], (G, P)))
     o_v_term = jnp.where(cand_bcast, term[:, None],
-                         jnp.where(pre_bcast, term[:, None] + 1, resp_term))
+                         jnp.where(vreq | preq, resp_term,
+                                   jnp.where(pre_bcast, term[:, None] + 1,
+                                             resp_term)))
     o_v_last_idx = jnp.broadcast_to(log_len[:, None], (G, P))
     o_v_last_term = jnp.broadcast_to(my_last_term2[:, None], (G, P))
-    o_v_granted = (grant | pre_grant) & ~cand_bcast & ~pre_bcast
+    o_v_granted = (grant | pre_grant) & ~cand_bcast
 
     # Append responses (to every append request seen, incl. stale-term ones
     # so old leaders step down).

@@ -160,9 +160,52 @@ class RaftDB:
         idx, blob = fn()
         return (idx, blob) if idx > 0 else None
 
+    # Grace before failing acks orphaned by a snapshot install: commits
+    # ABOVE the snapshot still publish normally and must keep their acks.
+    SNAPSHOT_ACK_GRACE_S = 5.0
+
     def _install_snapshot(self, group: int, index: int,
                           blob: bytes) -> None:
         self._sms[group].install(blob, index)
+        # A state transfer SKIPS the log: proposals whose commits sit
+        # INSIDE the snapshot are never published here, so their acks
+        # would wait forever (the reference never snapshots and inherits
+        # the hang only for lost proposals).  But a pending ack may also
+        # belong to a commit ABOVE the snapshot — about to stream in and
+        # ack normally — and the two are indistinguishable by (group,
+        # query) key.  So: snapshot the exact callbacks pending NOW, give
+        # the post-install catch-up a grace window to drain them, and
+        # fail only the leftovers with a retriable error.  Hazard,
+        # documented: a flushed write may in fact be inside the installed
+        # state — a client retrying a non-idempotent statement should
+        # verify first (same duplicate exposure as the reference's
+        # content-keyed FIFO, db.go:112-118).
+        with self._mu:
+            stale = [(k, cb) for k, cbs in self._q2cb.items()
+                     if k[0] == group for cb in cbs]
+        if not stale:
+            return
+        err = RuntimeError(
+            f"group {group}: pending proposal superseded by snapshot "
+            f"install at index {index}; state may include the write — "
+            "verify before retrying")
+
+        def flush():
+            victims = []
+            with self._mu:
+                for k, cb in stale:
+                    cbs = self._q2cb.get(k)
+                    if cbs and cb in cbs:
+                        cbs.remove(cb)
+                        if not cbs:
+                            self._q2cb.pop(k, None)
+                        victims.append(cb)
+            for cb in victims:
+                cb.set(err)
+
+        t = threading.Timer(self.SNAPSHOT_ACK_GRACE_S, flush)
+        t.daemon = True
+        t.start()
 
     def _maybe_compact(self) -> None:
         if not self._compact_every:

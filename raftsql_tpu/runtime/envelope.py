@@ -46,19 +46,66 @@ def unwrap(data: bytes) -> Tuple[Optional[int], bytes]:
 
 
 class DedupWindow:
-    """Sliding window of recently applied proposal ids for one group."""
+    """Sliding window of recently applied proposal ids for one group.
+
+    Entries carry the log index they were applied at so the window can be
+    SNAPSHOTTED consistently: a state transfer at applied index A must
+    ship exactly the ids applied at or below A — shipping the live window
+    (which may run ahead of the state machine's applied point) would make
+    the receiver skip entries whose effects its installed state does not
+    contain (runtime/node.py InstallSnapshot path)."""
 
     def __init__(self, cap: int = 4096):
         self._cap = cap
-        self._fifo: deque = deque()
+        self._fifo: deque = deque()          # (idx, pid), idx ascending
         self._set: set = set()
 
-    def seen(self, pid: int) -> bool:
+    def seen(self, pid: int, idx: int = 0) -> bool:
         """Check-and-insert; True if pid was already applied recently."""
         if pid in self._set:
             return True
         self._set.add(pid)
-        self._fifo.append(pid)
+        self._fifo.append((idx, pid))
         if len(self._fifo) > self._cap:
-            self._set.discard(self._fifo.popleft())
+            self._set.discard(self._fifo.popleft()[1])
         return False
+
+    def pairs_upto(self, idx: int) -> list:
+        """(idx, pid) pairs applied at or below `idx`, FIFO order."""
+        return [(i, p) for (i, p) in self._fifo if i <= idx]
+
+    def restore(self, pairs) -> None:
+        """Replace the window contents (InstallSnapshot receiver side)."""
+        self._fifo = deque(pairs)
+        self._set = {p for (_, p) in self._fifo}
+        while len(self._fifo) > self._cap:
+            self._set.discard(self._fifo.popleft()[1])
+
+
+# Snapshot-blob framing: the node wraps the state machine's opaque blob
+# with the dedup window so exactly-once survives a full state transfer.
+_SNAP_MAGIC = 0x02
+_SNAP_HDR = struct.Struct("<BI")
+_SNAP_PAIR = struct.Struct("<QQ")
+
+
+def wrap_snapshot(pairs, sm_blob: bytes) -> bytes:
+    out = [_SNAP_HDR.pack(_SNAP_MAGIC, len(pairs))]
+    for i, p in pairs:
+        out.append(_SNAP_PAIR.pack(i, p))
+    out.append(sm_blob)
+    return b"".join(out)
+
+
+def unwrap_snapshot(blob: bytes):
+    """Returns (pairs or None, sm_blob).  Blobs without the magic are
+    treated as bare state-machine blobs (window untouched)."""
+    if len(blob) >= _SNAP_HDR.size and blob[0] == _SNAP_MAGIC:
+        _, n = _SNAP_HDR.unpack_from(blob)
+        off = _SNAP_HDR.size
+        need = off + n * _SNAP_PAIR.size
+        if len(blob) >= need:
+            pairs = [_SNAP_PAIR.unpack_from(blob, off + k * _SNAP_PAIR.size)
+                     for k in range(n)]
+            return pairs, blob[need:]
+    return None, blob

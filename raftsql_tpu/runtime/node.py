@@ -44,7 +44,9 @@ from raftsql_tpu.core.state import (Inbox, init_peer_state,
                                     install_snapshot_state,
                                     restore_peer_state, set_peer_progress)
 from raftsql_tpu.core.step import peer_step_jit
-from raftsql_tpu.runtime.envelope import DedupWindow, unwrap, wrap
+from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
+                                          unwrap_snapshot, wrap,
+                                          wrap_snapshot)
 from raftsql_tpu.storage.log import PayloadLog
 from raftsql_tpu.storage.wal import WAL, wal_exists
 from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
@@ -112,12 +114,24 @@ class RaftNode:
         # payload identity — the same content-FIFO quirk as the ack
         # router (SURVEY.md §2d.3).
         self._fwd: List[List[Tuple[bytes, int]]] = [[] for _ in range(G)]
+        # Our own proposals accepted into OUR log as leader, still
+        # uncommitted: (log_idx, payload).  A deposed (e.g. minority)
+        # leader's uncommitted suffix is conflict-truncated by the new
+        # leader's first append — without this tracking those proposals
+        # vanish and their clients hang forever (the reference loses them
+        # the same way through etcd/raft; the envelope dedup makes the
+        # requeue-retry safe).  Tick-thread only, no lock.
+        self._local: List[List[Tuple[int, bytes]]] = [[] for _ in range(G)]
         self._tick_no = 0
 
         self.payload_log = PayloadLog(G)
-        self._applied = [0] * G
+        # [G] applied index and [G, 3] (term, voted_for, commit) hard-state
+        # cache as numpy arrays: every tick compares/updates ALL groups, so
+        # these must be vectorized state, not per-group Python objects.
+        self._applied = np.zeros(G, np.int64)
         self._dedup = [DedupWindow() for _ in range(G)]
-        self._hard_cache: Dict[int, Tuple[int, int, int]] = {}
+        self._hard_np = np.zeros((G, 3), np.int64)
+        self._hard_np[:, 1] = NO_VOTE
 
         self._stop_evt = threading.Event()
         self._stopped = False           # full teardown ran (stop())
@@ -142,8 +156,7 @@ class RaftNode:
             self.payload_log.put(g, gl.start + 1,
                                  [d for (_, d) in gl.entries],
                                  [t for (t, _) in gl.entries])
-            self._hard_cache[g] = (gl.hard.term, gl.hard.vote,
-                                   gl.hard.commit)
+            self._hard_np[g] = (gl.hard.term, gl.hard.vote, gl.hard.commit)
             # Reference parity: replay publishes every WAL entry, then the
             # nil sentinel (raft.go:130-132); apply-at-commit only governs
             # live traffic.  Empty (no-op/conf) entries are skipped
@@ -156,18 +169,22 @@ class RaftNode:
     # ------------------------------------------------------------------
     # lifecycle
 
-    def start(self) -> None:
+    def start(self, threaded: bool = True) -> None:
+        """Publish the WAL replay + sentinel, start the transport, and —
+        unless threaded=False (benchmarks/tests that drive `tick()`
+        manually for deterministic lockstep) — the tick thread."""
         for g, gl in sorted(self._replay_groups.items()):
             for i, (term, data) in enumerate(gl.entries):
-                sql = self._decode_entry(g, data)
+                sql = self._decode_entry(g, data, gl.start + 1 + i)
                 if sql is not None:
                     self.commit_q.put((g, gl.start + 1 + i, sql))
         self._replay_groups = {}
         self.commit_q.put(None)         # replay-complete sentinel
         self.transport.start(self.node_id, self._deliver, self._on_error)
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"raft-node-{self.node_id}")
-        self._thread.start()
+        if threaded:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=f"raft-node-{self.node_id}")
+            self._thread.start()
 
     def stop(self) -> None:
         # _on_error may have set _stop_evt already (transport failure
@@ -206,13 +223,27 @@ class RaftNode:
         with self._prop_lock:
             self._props[group].append(wrap(payload))
 
-    def _decode_entry(self, group: int, data: bytes) -> Optional[str]:
+    def propose_many(self, group: int, payloads) -> None:
+        """Batch `propose`: one lock hold and envelope pass for a whole
+        iterable of payloads (benchmark feeders at G x E per tick would
+        otherwise spend the tick budget on lock churn)."""
+        if not 0 <= group < self.cfg.num_groups:
+            raise ValueError(f"group {group} out of range "
+                             f"[0, {self.cfg.num_groups})")
+        wrapped = [wrap(p) for p in payloads]
+        with self._prop_lock:
+            self._props[group].extend(wrapped)
+
+    def _decode_entry(self, group: int, data: bytes,
+                      idx: int = 0) -> Optional[str]:
         """Envelope-aware publish decision: None = skip (empty entry or
-        duplicate of an already-applied forwarded proposal)."""
+        duplicate of an already-applied forwarded proposal).  `idx` is
+        the entry's log index — recorded in the dedup window so snapshot
+        transfers can ship exactly the window at their applied point."""
         if not data:
             return None
         pid, payload = unwrap(data)
-        if pid is not None and self._dedup[group].seen(pid):
+        if pid is not None and self._dedup[group].seen(pid, idx):
             return None
         return payload.decode("utf-8")
 
@@ -246,9 +277,9 @@ class RaftNode:
             changed = False
             floors: Dict[int, Tuple[int, int]] = {}
             for g in range(self.cfg.num_groups):
-                _, _, commit = self._hard_cache.get(g, (0, -1, 0))
+                commit = int(self._hard_np[g, 2])
                 floor = min(applied.get(g, 0), commit,
-                            self._applied[g]) - keep
+                            int(self._applied[g])) - keep
                 if floor > self.payload_log.start(g):
                     self.payload_log.compact(
                         g, floor, self.payload_log.term_of(g, floor))
@@ -258,7 +289,9 @@ class RaftNode:
                     floors[g] = (s, self.payload_log.term_of(g, s))
             if not changed:
                 return False
-            self.wal.compact(floors, self._hard_cache)
+            hard = {g: tuple(int(x) for x in self._hard_np[g])
+                    for g in range(self.cfg.num_groups)}
+            self.wal.compact(floors, hard)
             self.metrics.compactions += 1
             return True
 
@@ -316,9 +349,16 @@ class RaftNode:
                 time.sleep(interval - dt)
 
     def tick(self) -> None:
-        """One full consensus tick: stage → step → WAL → send → publish."""
+        """One full consensus tick: stage → step → WAL → send → publish.
+
+        Each phase's wall time accumulates into NodeMetrics (exported via
+        GET /metrics as per-tick averages — SURVEY.md §5.1's live-runtime
+        profiling), so a slow tick localizes to device step vs WAL fsync
+        vs transport vs publish without a profiler attached."""
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
+        m = self.metrics
+        t0 = time.monotonic()
 
         self._install_snapshots()
         inbox, tick_apps = self._build_inbox()
@@ -332,13 +372,21 @@ class RaftNode:
             cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr)
         self.state = state
         outbox, info = jax.device_get((outbox, info))
+        t1 = time.monotonic()
 
         with self._wal_lock:
             self._wal_phase(info)       # durable …
+        t2 = time.monotonic()
         self._send_phase(outbox, info)  # … before sent …
+        t3 = time.monotonic()
         self._publish_phase(info)       # … before published.
+        t4 = time.monotonic()
+        m.t_device_ms += (t1 - t0) * 1e3
+        m.t_wal_ms += (t2 - t1) * 1e3
+        m.t_send_ms += (t3 - t2) * 1e3
+        m.t_publish_ms += (t4 - t3) * 1e3
         self._tick_no += 1
-        self.metrics.ticks += 1
+        m.ticks += 1
 
     # -- tick phases -----------------------------------------------------
 
@@ -383,8 +431,9 @@ class RaftNode:
                 term[g] = rec.term
             if rec.last_idx <= max(self._applied[g], int(commit[g])):
                 continue
+            pairs, sm_blob = unwrap_snapshot(rec.blob)
             try:
-                self.snapshot_installer(g, rec.last_idx, rec.blob)
+                self.snapshot_installer(g, rec.last_idx, sm_blob)
             except Exception as e:
                 # A corrupt/truncated transfer must not tear down the
                 # node (cf. the _deliver contract); drop it — the leader
@@ -396,6 +445,10 @@ class RaftNode:
             # see the data the moment the state machine has it, while the
             # device-state patch below may still be compiling.
             self.metrics.snapshots_installed += 1
+            if pairs is not None:
+                # Adopt the sender's dedup window at the transfer point,
+                # keeping exactly-once across the state jump.
+                self._dedup[g].restore(pairs)
             # The whole install — payload-log reset, WAL marker, device
             # patch, applied floor — is one atomic unit vs. compact()'s
             # multi-call read of the payload log (it holds _wal_lock for
@@ -409,6 +462,15 @@ class RaftNode:
                     self.state, g, rec.last_idx, rec.last_term,
                     self.cfg.log_window, rec.term)
                 self._applied[g] = rec.last_idx
+            if self._local[g]:
+                # Our uncommitted leader-era proposals may or may not be
+                # inside the installed state; requeue them all — the
+                # transferred dedup window skips any that were, and the
+                # rest get their honest retry.
+                with self._prop_lock:
+                    self._props[g].extendleft(
+                        reversed([d for (_, d) in self._local[g]]))
+                self._local[g] = []
             log.info("node %d g%d: installed snapshot at idx %d",
                      self.node_id, g, rec.last_idx)
 
@@ -450,16 +512,21 @@ class RaftNode:
     def _wal_phase(self, info) -> None:
         """Persist this tick's appends + hard-state changes, one fsync.
 
-        Entry records are accumulated across all groups and written with
-        ONE batched WAL call (the C++ fast path frames them without a
-        per-record Python round trip — native/wal.cc)."""
-        G = self.cfg.num_groups
-        term = info.term
+        Vectorized over groups: numpy masks pick out only the groups that
+        did something this tick (leader append, accepted follower append,
+        hard-state delta), so an idle group costs zero Python work — the
+        round-1/2 hot loop was O(G) every tick regardless of activity.
+        Entry records accumulate across all groups into ONE batched WAL
+        call (the C++ fast path frames them without a per-record Python
+        round trip — native/wal.cc)."""
+        term = np.asarray(info.term)
+        noop = np.asarray(info.noop)
+        prop_acc = np.asarray(info.prop_accepted)
+        app_from = np.asarray(info.app_from)
         w_groups: List[int] = []
         w_idx: List[int] = []
         w_terms: List[int] = []
         w_data: List[bytes] = []
-        hard_changes: List[Tuple[int, Tuple[int, int, int]]] = []
 
         def put_rec(g: int, idx: int, t: int, data: bytes) -> None:
             w_groups.append(g)
@@ -467,11 +534,12 @@ class RaftNode:
             w_terms.append(t)
             w_data.append(data)
 
-        for g in range(G):
-            n_acc = int(info.prop_accepted[g])
-            if info.noop[g] or n_acc:
+        active = np.nonzero(noop | (prop_acc > 0) | (app_from >= 0))[0]
+        for g in active.tolist():
+            n_acc = int(prop_acc[g])
+            if noop[g] or n_acc:
                 base = int(info.prop_base[g])
-                if info.noop[g]:
+                if noop[g]:
                     put_rec(g, base, int(term[g]), b"")
                     self.payload_log.put(g, base, [b""], [int(term[g])])
                 if n_acc:
@@ -480,10 +548,11 @@ class RaftNode:
                                  for _ in range(n_acc)]
                     for i, data in enumerate(batch):
                         put_rec(g, base + 1 + i, int(term[g]), data)
+                        self._local[g].append((base + 1 + i, data))
                     self.payload_log.put(g, base + 1, batch,
                                          [int(term[g])] * n_acc)
                 self.metrics.proposals += n_acc
-            src = int(info.app_from[g])
+            src = int(app_from[g])
             if src >= 0:
                 rec = self._tick_apps.get((g, src))
                 if rec is None:      # staged slot raced away; next resend
@@ -495,6 +564,17 @@ class RaftNode:
                             rec.payloads[i])
                 self.payload_log.put(g, start, rec.payloads,
                                      rec.ent_terms, new_len=new_len)
+                if info.app_conflict[g] and self._local[g]:
+                    # The new leader's suffix clobbered entries we
+                    # appended as a (now deposed) leader: requeue their
+                    # payloads for a fresh propose/forward round.
+                    mine = self._local[g]
+                    requeue = [d for (ix, d) in mine if ix >= start]
+                    if requeue:
+                        with self._prop_lock:
+                            self._props[g].extendleft(reversed(requeue))
+                    self._local[g] = [(ix, d) for (ix, d) in mine
+                                      if ix < start]
                 if info.app_conflict[g] and self._applied[g] >= start:
                     # Only possible for replay-published uncommitted
                     # entries (the reference applies at append and shares
@@ -504,16 +584,18 @@ class RaftNode:
                                 "an uncommitted entry", self.node_id, g,
                                 self._applied[g])
                     self._applied[g] = min(self._applied[g], start - 1)
-            hs = (int(term[g]), int(info.voted_for[g]), int(info.commit[g]))
-            if self._hard_cache.get(g) != hs:
-                hard_changes.append((g, hs))
-                self._hard_cache[g] = hs
+        # Hard-state delta detection is one vectorized compare over [G, 3].
+        hs = np.stack([term, np.asarray(info.voted_for),
+                       np.asarray(info.commit)], axis=1)
+        hard_changed = np.nonzero((hs != self._hard_np).any(axis=1))[0]
         # Entries land before hard states (etcd wal.Save order): a torn
         # tail can then never leave a hard state referencing lost entries.
         if w_groups:
             self.wal.append_entries(w_groups, w_idx, w_terms, w_data)
-        for g, hs in hard_changes:
-            self.wal.set_hardstate(g, *hs)
+        for g in hard_changed.tolist():
+            self.wal.set_hardstate(g, int(hs[g, 0]), int(hs[g, 1]),
+                                   int(hs[g, 2]))
+        self._hard_np[hard_changed] = hs[hard_changed]
         self.wal.sync()
 
     def _build_catchups(self, info) -> Dict[Tuple[int, int], AppendRec]:
@@ -545,6 +627,18 @@ class RaftNode:
         lag = (role == LEADER)[:, None] & (next_idx >= 1) \
             & (next_idx - 1 <= log_len[:, None] - W + 2 * E)
         lag[:, self.self_id] = False
+        # Prune pacing state for peers that caught back up (its purpose
+        # is served) and stale snapshot cooldowns (any in-flight transfer
+        # resolves within a few cooldowns) — both maps are bounded at
+        # O(G*P) but would otherwise hold dead entries forever.
+        if self._catchup_sent:
+            for k in [k for k in self._catchup_sent if not lag[k]]:
+                del self._catchup_sent[k]
+        if self._snap_sent:
+            horizon = self._tick_no - 128 * self.cfg.election_ticks
+            for k in [k for k, t in self._snap_sent.items()
+                      if t < horizon]:
+                del self._snap_sent[k]
         out: Dict[Tuple[int, int], AppendRec] = {}
         for g, d in zip(*np.nonzero(lag)):
             g, d = int(g), int(d)
@@ -583,47 +677,63 @@ class RaftNode:
 
         catchups = self._build_catchups(info)
 
+        # Columnar field extraction: one fancy-index gather per field plus
+        # a single .tolist() each, then a plain zip — per-element
+        # np-scalar indexing (the round-1/2 shape) costs ~10x more per
+        # message and dominated the tick at G >= 10k.
         vg, vd = np.nonzero(outbox.v_type)
-        for g, d in zip(vg.tolist(), vd.tolist()):
-            batch_for(d).votes.append(VoteRec(
-                group=g, type=int(outbox.v_type[g, d]),
-                term=int(outbox.v_term[g, d]),
-                last_idx=int(outbox.v_last_idx[g, d]),
-                last_term=int(outbox.v_last_term[g, d]),
-                granted=bool(outbox.v_granted[g, d])))
+        if vg.size:
+            for g, d, t, tm, li, lt, gr in zip(
+                    vg.tolist(), vd.tolist(),
+                    outbox.v_type[vg, vd].tolist(),
+                    outbox.v_term[vg, vd].tolist(),
+                    outbox.v_last_idx[vg, vd].tolist(),
+                    outbox.v_last_term[vg, vd].tolist(),
+                    outbox.v_granted[vg, vd].tolist()):
+                batch_for(d).votes.append(VoteRec(
+                    group=g, type=t, term=tm, last_idx=li, last_term=lt,
+                    granted=gr))
         ag, ad = np.nonzero(outbox.a_type)
         emitted = set()
-        for g, d in zip(ag.tolist(), ad.tolist()):
-            emitted.add((g, d))
-            mtype = int(outbox.a_type[g, d])
-            cu = catchups.pop((g, d), None) if mtype == MSG_REQ else None
-            if cu is not None:
-                # The device could only offer an empty heartbeat to this
-                # out-of-window follower; substitute the host-built
-                # catch-up append (same slot, newest-wins semantics).
-                batch_for(d).appends.append(cu)
-                continue
-            n = int(outbox.a_n[g, d])
-            prev = int(outbox.a_prev_idx[g, d])
-            if mtype == MSG_REQ:
-                # The device ring can reference positions below the
-                # payload floor (log-length regression after conflict
-                # truncation / snapshot install, or a concurrent
-                # compaction advancing the floor).  try_slice is atomic
-                # against the compactor; on miss, drop the message — the
-                # peer is served by catch-up or snapshot on a later tick.
-                payloads = self.payload_log.try_slice(g, prev + 1, n)
-                if payloads is None:
+        if ag.size:
+            a_ents_rows = outbox.a_ents[ag, ad]          # [N, E]
+            for i, (g, d, mtype, tm, prev, pt, n, cm, su, ma) in enumerate(
+                    zip(ag.tolist(), ad.tolist(),
+                        outbox.a_type[ag, ad].tolist(),
+                        outbox.a_term[ag, ad].tolist(),
+                        outbox.a_prev_idx[ag, ad].tolist(),
+                        outbox.a_prev_term[ag, ad].tolist(),
+                        outbox.a_n[ag, ad].tolist(),
+                        outbox.a_commit[ag, ad].tolist(),
+                        outbox.a_success[ag, ad].tolist(),
+                        outbox.a_match[ag, ad].tolist())):
+                emitted.add((g, d))
+                cu = catchups.pop((g, d), None) if mtype == MSG_REQ else None
+                if cu is not None:
+                    # The device could only offer an empty heartbeat to
+                    # this out-of-window follower; substitute the
+                    # host-built catch-up append (same slot, newest-wins
+                    # semantics).
+                    batch_for(d).appends.append(cu)
                     continue
-            else:
-                payloads = []
-            batch_for(d).appends.append(AppendRec(
-                group=g, type=mtype, term=int(outbox.a_term[g, d]),
-                prev_idx=prev, prev_term=int(outbox.a_prev_term[g, d]),
-                ent_terms=[int(t) for t in outbox.a_ents[g, d, :n]],
-                payloads=payloads, commit=int(outbox.a_commit[g, d]),
-                success=bool(outbox.a_success[g, d]),
-                match=int(outbox.a_match[g, d])))
+                if mtype == MSG_REQ:
+                    # The device ring can reference positions below the
+                    # payload floor (log-length regression after conflict
+                    # truncation / snapshot install, or a concurrent
+                    # compaction advancing the floor).  try_slice is
+                    # atomic against the compactor; on miss, drop the
+                    # message — the peer is served by catch-up or
+                    # snapshot on a later tick.
+                    payloads = self.payload_log.try_slice(g, prev + 1, n)
+                    if payloads is None:
+                        continue
+                else:
+                    payloads = []
+                batch_for(d).appends.append(AppendRec(
+                    group=g, type=mtype, term=tm,
+                    prev_idx=prev, prev_term=pt,
+                    ent_terms=a_ents_rows[i, :n].tolist(),
+                    payloads=payloads, commit=cm, success=su, match=ma))
         for (g, d), cu in catchups.items():
             if (g, d) in emitted:
                 # The device emitted a (response) message for this slot;
@@ -654,6 +764,13 @@ class RaftNode:
                     # below its own applied index); don't send garbage.
                     continue
                 self._snap_sent[(g, d)] = self._tick_no
+                # Ship the dedup window AS OF the snapshot's applied
+                # index inside the blob: without it the receiver either
+                # re-applies a forward-retried duplicate the snapshot
+                # already contains, or (shipping the live window) skips
+                # entries its installed state lacks — both diverge.
+                blob = wrap_snapshot(
+                    self._dedup[g].pairs_upto(last_idx), blob)
                 batch_for(d).snapshots.append(SnapshotRec(
                     group=g, last_idx=last_idx,
                     last_term=self.payload_log.term_of(g, last_idx),
@@ -697,20 +814,29 @@ class RaftNode:
                                        + len(batch.snapshots))
 
     def _publish_phase(self, info) -> None:
-        for g in range(self.cfg.num_groups):
-            c = int(info.commit[g])
-            while self._applied[g] < c:
-                idx = self._applied[g] + 1
+        # Vectorized group selection: only groups whose commit advanced
+        # past their applied point do any Python work this tick.
+        commit = np.asarray(info.commit)
+        ready = np.nonzero(commit > self._applied)[0]
+        for g in ready.tolist():
+            c = int(commit[g])
+            a = int(self._applied[g])
+            fwd = self._fwd[g]
+            for idx in range(a + 1, c + 1):
                 data = self.payload_log.get(g, idx)
-                if data and self._fwd[g]:
+                if data and fwd:
                     # Forwarded proposal observed committed: retire it
                     # (exact match — envelope ids are unique).
-                    for k, (p, _) in enumerate(self._fwd[g]):
+                    for k, (p, _) in enumerate(fwd):
                         if p == data:
-                            del self._fwd[g][k]
+                            del fwd[k]
                             break
-                sql = self._decode_entry(g, data)
+                sql = self._decode_entry(g, data, idx)
                 if sql is not None:
                     self.commit_q.put((g, idx, sql))
-                self._applied[g] += 1
-                self.metrics.commits += 1
+            self._applied[g] = c
+            self.metrics.commits += c - a
+            if self._local[g]:
+                # Committed own-proposals need no deposal-requeue cover.
+                self._local[g] = [(ix, d) for (ix, d) in self._local[g]
+                                  if ix > c]

@@ -41,12 +41,19 @@ class FaultPlan:
 
 
 class LoopbackHub:
-    """Shared registry wiring N LoopbackTransports together."""
+    """Shared registry wiring N LoopbackTransports together.
 
-    def __init__(self, faults: Optional[FaultPlan] = None):
+    codec=False skips the encode/decode round trip and hands the
+    TickBatch object across directly — for benchmarks that measure the
+    engine rather than the wire format (tests keep the default True so
+    every suite run exercises the real codec)."""
+
+    def __init__(self, faults: Optional[FaultPlan] = None,
+                 codec: bool = True):
         self._nodes: Dict[int, Callable[[int, TickBatch], None]] = {}
         self._lock = threading.Lock()
         self.faults = faults or FaultPlan()
+        self.codec = codec
 
     def attach(self, node_id: int,
                deliver: Callable[[int, TickBatch], None]) -> None:
@@ -57,13 +64,14 @@ class LoopbackHub:
         with self._lock:
             self._nodes.pop(node_id, None)
 
-    def route(self, src: int, dst: int, blob: bytes) -> None:
+    def route(self, src: int, dst: int, batch) -> None:
+        """`batch` is encoded bytes (codec=True) or a TickBatch object."""
         if self.faults.blocked(src, dst):
             return
         with self._lock:
             deliver = self._nodes.get(dst)
         if deliver is not None:            # absent peer == dropped message
-            deliver(src, decode_batch(blob))
+            deliver(src, decode_batch(batch) if self.codec else batch)
 
 
 class LoopbackTransport(Transport):
@@ -80,7 +88,8 @@ class LoopbackTransport(Transport):
     def send(self, dst: int, batch: TickBatch) -> None:
         if batch.empty():
             return
-        self.hub.route(self.node_id, dst, encode_batch(batch))
+        self.hub.route(self.node_id, dst,
+                       encode_batch(batch) if self.hub.codec else batch)
 
     def stop(self) -> None:
         self.hub.detach(self.node_id)

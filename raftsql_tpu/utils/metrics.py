@@ -22,10 +22,17 @@ class NodeMetrics:
     compactions: int = 0
     snapshots_sent: int = 0
     snapshots_installed: int = 0
+    # Per-phase tick wall time, accumulated by RaftNode.tick (SURVEY.md
+    # §5.1 live profiling): device step / WAL fsync / send / publish.
+    t_device_ms: float = 0.0
+    t_wal_ms: float = 0.0
+    t_send_ms: float = 0.0
+    t_publish_ms: float = 0.0
     started_at: float = field(default_factory=time.monotonic)
 
     def snapshot(self) -> dict:
         up = max(time.monotonic() - self.started_at, 1e-9)
+        t = max(self.ticks, 1)
         return {
             "ticks": self.ticks,
             "proposals": self.proposals,
@@ -37,6 +44,12 @@ class NodeMetrics:
             "snapshots_installed": self.snapshots_installed,
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
+            "phase_ms_per_tick": {
+                "device": round(self.t_device_ms / t, 4),
+                "wal": round(self.t_wal_ms / t, 4),
+                "send": round(self.t_send_ms / t, 4),
+                "publish": round(self.t_publish_ms / t, 4),
+            },
         }
 
 

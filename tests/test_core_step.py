@@ -244,6 +244,46 @@ class TestPrevote:
         assert (leaders_per_group(states, cfg) == 1).all()
 
 
+class TestRingAliasGuard:
+    def test_stale_append_below_ring_window_rejected(self):
+        """An append whose prev slid out of the W-entry term ring must be
+        REJECTED even when the aliased ring slot happens to hold a
+        matching term (e.g. a stale leader replaying after the follower
+        installed a snapshot that cleared the ring): accepting it
+        conflict-truncates a log it never actually matched.  Found by
+        tests/test_stress.py — the crash wiped the payload log and
+        regressed the publish cursor."""
+        from raftsql_tpu.config import MSG_REQ
+        from raftsql_tpu.core.state import (empty_inbox,
+                                            install_snapshot_state,
+                                            init_peer_state)
+        from raftsql_tpu.core.step import peer_step
+
+        cfg = small_cfg(num_groups=1, log_window=16, max_entries_per_msg=4)
+        W = cfg.log_window
+        st = init_peer_state(cfg, 1)
+        # Snapshot-installed state: log == commit == 57, ring cleared
+        # except the boundary slot (term 2).  Slot (41-1) % 16 ==
+        # slot (57-1) % 16, so term_at(41) aliases the boundary.
+        st = install_snapshot_state(st, 0, 57, 2, W, 2)
+        ib = empty_inbox(cfg)
+        ib = ib._replace(
+            a_type=ib.a_type.at[0, 0].set(MSG_REQ),
+            a_term=ib.a_term.at[0, 0].set(2),
+            a_prev_idx=ib.a_prev_idx.at[0, 0].set(41),
+            a_prev_term=ib.a_prev_term.at[0, 0].set(2),  # == aliased slot
+            a_n=ib.a_n.at[0, 0].set(2),
+            a_ents=ib.a_ents.at[0, 0, :2].set(3),
+            a_commit=ib.a_commit.at[0, 0].set(45))
+        st2, out, info = peer_step(cfg, st, ib,
+                                   jnp.zeros((1,), jnp.int32),
+                                   jnp.asarray(1, jnp.int32))
+        assert int(info.app_from[0]) == -1, "stale append was accepted"
+        assert int(st2.log_len[0]) == 57, "log truncated by stale append"
+        assert int(st2.commit[0]) == 57
+        assert not bool(info.app_conflict[0])
+
+
 class TestCommitSafety:
     def test_commit_monotone(self):
         cfg = small_cfg(seed=11)
