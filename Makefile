@@ -17,9 +17,13 @@ native:
 test:
 	$(PY) -m pytest tests/ -q 2>&1 | tee test.out
 
-# Static analysis stand-in for `go vet`: compile every source file.
+# Static analysis stand-in for `go vet`: compile every source file, then
+# the AST checks in scripts/vet.py (unused imports, duplicate defs,
+# mutable defaults, tuple asserts, bare excepts).
 vet:
-	$(PY) -m compileall -q raftsql_tpu tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q raftsql_tpu tests bench.py __graft_entry__.py \
+	      scripts
+	$(PY) scripts/vet.py
 
 bench:
 	$(PY) bench.py

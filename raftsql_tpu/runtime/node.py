@@ -38,10 +38,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP, NO_VOTE,
+from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, NO_VOTE,
                                 RaftConfig)
-from raftsql_tpu.core.state import (Inbox, init_peer_state,
-                                    install_snapshot_state,
+from raftsql_tpu.core.state import (Inbox, install_snapshot_state,
                                     restore_peer_state, set_peer_progress)
 from raftsql_tpu.core.step import peer_step_jit
 from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
@@ -358,8 +357,11 @@ class RaftNode:
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
         m = self.metrics
-        t0 = time.monotonic()
 
+        # Staging (snapshot installs + inbox build) is timed separately
+        # from the device step — a multi-MB install must not read as "the
+        # JAX step got slow" in /metrics.
+        ts = time.monotonic()
         self._install_snapshots()
         inbox, tick_apps = self._build_inbox()
         self._tick_apps = tick_apps
@@ -367,6 +369,8 @@ class RaftNode:
         with self._prop_lock:
             prop_n = np.fromiter(
                 (min(len(q), E) for q in self._props), np.int32, G)
+        t0 = time.monotonic()
+        m.t_stage_ms += (t0 - ts) * 1e3
 
         state, outbox, info = peer_step_jit(
             cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr)

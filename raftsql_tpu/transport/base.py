@@ -19,7 +19,7 @@ staging inbound records into device arrays is a plain scatter.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Protocol, Sequence
+from typing import Callable, List, Protocol
 
 
 @dataclass

@@ -23,7 +23,9 @@ class NodeMetrics:
     snapshots_sent: int = 0
     snapshots_installed: int = 0
     # Per-phase tick wall time, accumulated by RaftNode.tick (SURVEY.md
-    # §5.1 live profiling): device step / WAL fsync / send / publish.
+    # §5.1 live profiling): staging (installs + inbox build) / device
+    # step / WAL fsync / send / publish.
+    t_stage_ms: float = 0.0
     t_device_ms: float = 0.0
     t_wal_ms: float = 0.0
     t_send_ms: float = 0.0
@@ -45,6 +47,7 @@ class NodeMetrics:
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
             "phase_ms_per_tick": {
+                "stage": round(self.t_stage_ms / t, 4),
                 "device": round(self.t_device_ms / t, 4),
                 "wal": round(self.t_wal_ms / t, 4),
                 "send": round(self.t_send_ms / t, 4),

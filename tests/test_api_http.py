@@ -1,7 +1,6 @@
 """HTTP API tests: reference semantics (PUT/GET/405, httpapi.go:36-66)
 plus the multi-group and robustness extensions."""
 import http.client
-import os
 
 import pytest
 

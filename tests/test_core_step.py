@@ -7,9 +7,8 @@ SURVEY.md §4 lists leader-election tests among the gaps to close.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from raftsql_tpu.config import CANDIDATE, FOLLOWER, LEADER, RaftConfig
+from raftsql_tpu.config import LEADER, RaftConfig
 from raftsql_tpu.core.cluster import (cluster_run, empty_cluster_inbox,
                                       init_cluster_state)
 from raftsql_tpu.core.cluster import cluster_step_jit as cluster_step

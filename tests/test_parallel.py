@@ -10,7 +10,6 @@ pin its two key properties:
     peers×groups mesh (whose message routing is the ICI all_to_all);
   * liveness at scale: elections + commits proceed under the scan runner.
 """
-import dataclasses
 
 import jax
 import jax.numpy as jnp

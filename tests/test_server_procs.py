@@ -10,7 +10,6 @@ crash-restarts one node and requires catch-up.
 import http.client
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time

@@ -190,8 +190,6 @@ class TestSnapshotTermCheck:
         return node, installs
 
     def test_stale_term_snapshot_rejected(self, tmp_path):
-        import jax.numpy as jnp
-
         from raftsql_tpu.transport.base import SnapshotRec
         node, installs = self._node(tmp_path)
         node.state = node.state._replace(

@@ -11,7 +11,6 @@ import socket
 import threading
 import time
 
-import pytest
 
 from conftest import free_port
 from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
