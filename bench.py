@@ -720,6 +720,19 @@ def main() -> None:
              f"{ {g: round(r['value'], 1) for g, r in results.items()} } "
              f"faults {faults}")
 
+    # -- 2b. commit-rule race on the device (point vs windowed vs
+    # pallas-compiled), at a mid-ladder shape so a kernel fault in one
+    # rule cannot cost the headline.
+    rules = None
+    if results and remaining() > fallback_reserve + 240 \
+            and os.environ.get("BENCH_SKIP_RULES") != "1":
+        rules_g = min(max(results), 10_000)
+        rules = _attempt(
+            "", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "rules", "BENCH_GROUPS": rules_g,
+                       "BENCH_TICKS": "200", "BENCH_REPEATS": "2"},
+            label=f"rules-G{rules_g}")
+
     # -- 3. durable-path child (host runtime measured on cpu).
     durable = None
     if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
@@ -736,6 +749,8 @@ def main() -> None:
             str(g): (round(results[g]["value"], 1) if g in results
                      else "fault: " + ";".join(faults.get(g, ["?"])))
             for g in ladder}
+        if rules:
+            parsed["rules"] = rules.get("rules")
         if durable:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")

@@ -56,7 +56,7 @@ class SQLiteStateMachine:
         # WAL compaction may only trust applied_index() as a floor when it
         # survives a crash (models/base.py contract).
         self.has_durable_snapshot = resume and path != ":memory:"
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = self._connect()
         self._lock = threading.Lock()
         self._applied = 0
         if resume:
@@ -69,69 +69,179 @@ class SQLiteStateMachine:
             ).fetchone()
             self._applied = int(row[0]) if row else 0
 
+    def _connect(self) -> sqlite3.Connection:
+        """Open self.path configured for this state machine: manual
+        transaction control (apply_batch brackets its own BEGIN/COMMIT
+        group commit — the module's implicit-BEGIN machinery would fight
+        the explicit statements) and journaling matched to the upstream
+        durability model.  Durability belongs to the raft WAL, not
+        SQLite:
+          - parity mode deletes and rebuilds this file from the log on
+            every boot (db.go:27-29), so per-statement fsync buys
+            nothing — memory journal, no syncs;
+          - resume mode needs (commands, applied_index) ATOMIC, not
+            durable-per-statement: SQLite-WAL + synchronous=NORMAL can
+            lose a recent tail on power loss but always rolls the file
+            back to a consistent point whose applied_index matches, and
+            the raft log replays forward from there — exactly-once
+            preserved at a fraction of the fsync cost."""
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.isolation_level = None
+        try:
+            if self.has_durable_snapshot:
+                conn.execute("PRAGMA journal_mode=WAL")
+                conn.execute("PRAGMA synchronous=NORMAL")
+            else:
+                conn.execute("PRAGMA journal_mode=MEMORY")
+                conn.execute("PRAGMA synchronous=OFF")
+        except sqlite3.Error:          # pragma: no cover - pragma support
+            pass
+        return conn
+
     def applied_index(self) -> int:
         return self._applied
 
     def apply(self, command: str, index: int = 0) -> Optional[Exception]:
+        return self.apply_batch([(command, index)])[0]
+
+    def apply_batch(self, items) -> list:
+        """Apply `[(command, index), ...]` in ONE durable transaction
+        (group commit): per-statement outcomes are isolated with
+        SAVEPOINTs, and the batch's statements plus the final
+        applied_index land atomically — so a crash re-delivers the whole
+        batch (exactly-once via the applied floor), never half of it.
+        Returns one Optional[Exception] per item.
+
+        The exactly-once check lives under the SAME lock install()
+        takes: a snapshot install racing the applier thread bumps
+        _applied before this runs, so a stale queued entry can never
+        re-apply over the installed image."""
         with self._lock:
-            # The authoritative exactly-once check lives under the SAME
-            # lock install() takes: a snapshot install racing the applier
-            # thread bumps _applied before this runs, so a stale queued
-            # entry can never re-apply over the installed image.
-            if self.resume and index and index <= self._applied:
-                return None
+            errs: list = []
+            attempted: list = []     # False = skipped as already applied
+            last = 0
             try:
-                self._conn.execute(command)
-                if self.resume and index:
-                    # Same transaction as the command: crash-atomic
-                    # exactly-once apply.
-                    self._conn.execute(
-                        "INSERT INTO _raft_meta (k, v) VALUES "
-                        "('applied_index', ?) ON CONFLICT(k) DO UPDATE "
-                        "SET v=excluded.v", (index,))
-                self._conn.commit()
+                self._conn.execute("BEGIN")
+            except sqlite3.Error:       # already in a transaction
+                pass
+            for command, index in items:
+                if self.resume and index and index <= self._applied:
+                    errs.append(None)
+                    attempted.append(False)
+                    continue
+                attempted.append(True)
+                try:
+                    self._conn.execute("SAVEPOINT _apply")
+                    self._conn.execute(command)
+                    self._conn.execute("RELEASE _apply")
+                    errs.append(None)
+                except sqlite3.Error as e:
+                    # A failed command still consumes its entry (the
+                    # error is its outcome, reference db.go:55-80): undo
+                    # only ITS effects, keep the batch.
+                    try:
+                        self._conn.execute("ROLLBACK TO _apply")
+                        self._conn.execute("RELEASE _apply")
+                    except sqlite3.Error:
+                        pass
+                    errs.append(e)
                 if index:
-                    self._applied = index
-                return None
+                    last = max(last, index)
+            meta = ("INSERT INTO _raft_meta (k, v) VALUES "
+                    "('applied_index', ?) ON CONFLICT(k) DO UPDATE "
+                    "SET v=excluded.v")
+            try:
+                if self.resume and last:
+                    self._conn.execute(meta, (last,))
+                self._conn.commit()
+                if last:
+                    self._applied = last
             except sqlite3.Error as e:
-                # A failed command still advances the applied index (the
-                # entry was consumed, its error is its outcome) — roll
-                # back its effects, then record the index alone.  The
-                # recovery writes get their own guard: if they too fail
-                # (disk full), the ORIGINAL error must still be returned
-                # rather than escaping and killing the applier thread.
+                # Commit failure (disk full): nothing of the batch
+                # landed.  Report it on every entry attempted in THIS
+                # transaction (skipped duplicates keep their None — they
+                # are durable from an earlier boot), then try to advance
+                # the durable floor alone so the entries stay consumed
+                # ("the error is their outcome") — the applied floor may
+                # only move when it is durable, because WAL compaction
+                # and snapshot labeling trust it (models/base.py).
                 try:
                     self._conn.rollback()
-                    if self.resume and index:
-                        self._conn.execute(
-                            "INSERT INTO _raft_meta (k, v) VALUES "
-                            "('applied_index', ?) ON CONFLICT(k) DO "
-                            "UPDATE SET v=excluded.v", (index,))
-                        self._conn.commit()
-                    if index:
-                        self._applied = index
                 except sqlite3.Error:
                     pass
-                return e
+                errs = [err if (err is not None or not att) else e
+                        for err, att in zip(errs, attempted)]
+                if last:
+                    try:
+                        if self.resume:
+                            self._conn.execute(meta, (last,))
+                            self._conn.commit()
+                        self._applied = last
+                    except sqlite3.Error:
+                        pass            # floor stays; log re-delivers
+            return errs
+
+    def _image(self) -> bytes:
+        """Serialize in DELETE journal mode: a WAL-mode image cannot be
+        `deserialize`d by a receiver (in-memory databases reject WAL),
+        and an image header should not advertise a -wal sidecar it does
+        not carry.  Caller holds the lock; the mode flip checkpoints,
+        which is fine at InstallSnapshot cadence."""
+        wal = self.has_durable_snapshot
+        if wal:
+            self._conn.execute("PRAGMA journal_mode=DELETE")
+        try:
+            return self._conn.serialize()
+        finally:
+            if wal:
+                self._conn.execute("PRAGMA journal_mode=WAL")
 
     def serialize(self) -> bytes:
         """Consistent point-in-time image of the database (the blob of an
         InstallSnapshot transfer)."""
         with self._lock:
-            return self._conn.serialize()
+            return self._image()
 
     def serialize_with_index(self):
         """(applied_index, image) captured atomically — the pair an
         InstallSnapshot sender needs (an apply sneaking between the two
         reads would mislabel the image's log position)."""
         with self._lock:
-            return self._applied, self._conn.serialize()
+            return self._applied, self._image()
 
     def install(self, blob: bytes, index: int) -> None:
         """Replace all state with a serialized image applied up to
-        `index` (receiver side of InstallSnapshot)."""
+        `index` (receiver side of InstallSnapshot).
+
+        With a real file, the image replaces the FILE (atomic tmp +
+        rename, stale -wal/-shm sidecars dropped) and the connection
+        reopens on it — `deserialize` would silently detach the
+        connection onto an in-memory copy, so post-install applies
+        never reached disk and a restart resurrected the pre-install
+        file.  The in-memory path keeps deserialize."""
         with self._lock:
-            self._conn.deserialize(blob)
+            if self.path != ":memory:":
+                # Image lands in a tmp file BEFORE the live connection
+                # closes: if the write fails (ENOSPC), the pre-install
+                # state machine stays fully usable and the node just
+                # drops the transfer.
+                tmp = self.path + ".snap"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._conn.close()
+                try:
+                    os.replace(tmp, self.path)
+                    for suffix in ("-wal", "-shm"):
+                        try:
+                            os.remove(self.path + suffix)
+                        except OSError:
+                            pass
+                finally:
+                    self._conn = self._connect()
+            else:
+                self._conn.deserialize(blob)
             if self.resume:
                 self._conn.execute(
                     "CREATE TABLE IF NOT EXISTS _raft_meta "

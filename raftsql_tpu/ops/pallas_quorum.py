@@ -68,7 +68,10 @@ def pallas_quorum_commit_index(match: jax.Array, log_term: jax.Array,
     """Drop-in replacement for `ops.quorum.quorum_commit_index`."""
     G, P = match.shape
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # "axon" is the remote-TPU PJRT tunnel — compile for it too, or
+        # the "hand-written TPU kernel" silently interprets on the very
+        # hardware it was written for.
+        interpret = jax.default_backend() not in ("tpu", "axon")
     gb = min(block_g, G)
     pad = (-G) % gb
     col = lambda x: x.astype(I32).reshape(G, 1)

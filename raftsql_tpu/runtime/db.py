@@ -28,6 +28,7 @@ sentinel) to tests — the reference's `commitListenerC` observability hook
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from collections import defaultdict, deque
@@ -108,6 +109,47 @@ class RaftDB:
 
     # ------------------------------------------------------------------
 
+    def _ack_one(self, group: int, query: str, err) -> None:
+        if self.listener is not None:
+            self.listener.put((group, query))
+        with self._mu:
+            cbs = self._q2cb.get((group, query))
+            if not cbs:
+                return                  # replayed or proposed elsewhere
+            cb = cbs.popleft()
+            if not cbs:
+                del self._q2cb[(group, query)]
+        cb.set(err)
+        self.latency.record(time.monotonic() - cb.created)
+
+    def _apply_run(self, run) -> None:
+        """Apply a drained run of commits with GROUP COMMIT: entries are
+        batched per state machine and applied in one durable transaction
+        each (models apply_batch; per-item fallback otherwise), then
+        acks/listeners fire in original commit order.  In resume mode
+        the state machine itself skips entries at or below its durable
+        applied index (atomically under its own lock, racing snapshot
+        installs safely) and returns None — so skipped-but-committed
+        entries still resolve their acks."""
+        per_g: Dict[int, list] = defaultdict(list)
+        for (group, index, query) in run:
+            per_g[group].append((query, index))
+        errs: Dict[int, list] = {}
+        for group, items in per_g.items():
+            sm = self._sms[group]
+            batch_fn = getattr(sm, "apply_batch", None)
+            if batch_fn is not None:
+                errs[group] = batch_fn(items)
+            else:
+                errs[group] = [sm.apply(qy, ix) for (qy, ix) in items]
+        pos = {g: 0 for g in per_g}
+        for (group, index, query) in run:
+            err = errs[group][pos[group]]
+            pos[group] += 1
+            self._ack_one(group, query, err)
+        for _ in run:
+            self._maybe_compact()
+
     def _read_commits(self, replay: bool = False) -> None:
         q = self.pipe.commit_q
         while True:
@@ -120,25 +162,34 @@ class RaftDB:
                 continue
             if item is CLOSED:
                 break
-            group, index, query = item
-            sm = self._sms[group]
-            # In resume mode the state machine itself skips entries at or
-            # below its durable applied index (atomically under its own
-            # lock, racing snapshot installs safely) and returns None —
-            # so skipped-but-committed entries still resolve their acks.
-            err = sm.apply(query, index)
-            self._maybe_compact()
-            if self.listener is not None:
-                self.listener.put((group, query))
-            with self._mu:
-                cbs = self._q2cb.get((group, query))
-                if not cbs:
-                    continue            # replayed or proposed elsewhere
-                cb = cbs.popleft()
-                if not cbs:
-                    del self._q2cb[(group, query)]
-            cb.set(err)
-            self.latency.record(time.monotonic() - cb.created)
+            # Greedy drain (live loop only): everything already queued
+            # joins this item's group-committed batch.  The replay pass
+            # must stay strictly item-at-a-time — draining could swallow
+            # live entries beyond the nil sentinel it returns at.
+            run = [item]
+            stop = False
+            if not replay:
+                while len(run) < 256:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        # Preserve the sentinel's position in the
+                        # listener protocol relative to this run.
+                        self._apply_run(run)
+                        run = []
+                        if self.listener is not None:
+                            self.listener.put(None)
+                        continue
+                    if nxt is CLOSED:
+                        stop = True
+                        break
+                    run.append(nxt)
+            if run:
+                self._apply_run(run)
+            if stop:
+                break
 
         # Stream closed: clean shutdown or error teardown (db.go:83-95).
         err = self.pipe.error

@@ -59,9 +59,6 @@ def check_file(path: str) -> list:
     for node in ast.walk(tree):
         if isinstance(node, ast.Name):
             used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # import a.b; a.b.c() — the Name 'a' is what gets marked.
-            pass
     # Names referenced in docstring-free __all__ or re-exported strings.
     for node in ast.walk(tree):
         if isinstance(node, ast.Constant) and isinstance(node.value, str):

@@ -273,6 +273,18 @@ class TestInstallSnapshot:
             while "999" not in dbs[1].query("SELECT v FROM t"):
                 assert time.monotonic() < deadline
                 time.sleep(0.02)
+
+            # Installed state must be ON DISK, not a connection-local
+            # in-memory copy: restart the installed follower and require
+            # its applied_index/data to come back from the FILE without
+            # needing another transfer (sqlite3.deserialize detaches to
+            # memory — install writes the image to the path instead).
+            installed_applied = dbs[1]._sms[0].applied_index()
+            assert installed_applied >= 120
+            dbs[1].close()
+            dbs[1] = _boot(tmp_path, hub, cfg, 1, resume=True)
+            assert dbs[1]._sms[0].applied_index() >= installed_applied
+            assert "999" in dbs[1].query("SELECT v FROM t")
         finally:
             for db in dbs:
                 if db is not None:

@@ -270,6 +270,37 @@ class TestEnvelope:
         assert not w.seen(4)       # evicts 1
         assert not w.seen(1)       # 1 slid out of the window
 
+    def test_dedup_pairs_upto_and_restore(self):
+        """The window snapshots consistently at an applied index: a
+        transfer at idx 20 must ship ids applied at or below 20 and NOT
+        the live tail beyond it (runtime/node.py InstallSnapshot)."""
+        from raftsql_tpu.runtime.envelope import DedupWindow
+        w = DedupWindow()
+        for idx, pid in ((10, 100), (20, 200), (30, 300)):
+            assert not w.seen(pid, idx)
+        pairs = w.pairs_upto(20)
+        assert pairs == [(10, 100), (20, 200)]
+        r = DedupWindow()
+        r.restore(pairs)
+        assert r.seen(100) and r.seen(200)
+        assert not r.seen(300)      # beyond the transfer: not skipped
+
+    def test_snapshot_blob_framing(self):
+        from raftsql_tpu.runtime.envelope import (unwrap_snapshot,
+                                                  wrap_snapshot)
+        pairs = [(5, 111), (9, 2**63 + 7)]
+        blob = wrap_snapshot(pairs, b"sm-state-bytes")
+        got, sm = unwrap_snapshot(blob)
+        assert got == pairs
+        assert sm == b"sm-state-bytes"
+
+    def test_snapshot_blob_bare_fallback(self):
+        """Blobs without the framing magic are treated as bare SM state
+        (back-compat with directly staged SnapshotRecs in tests)."""
+        from raftsql_tpu.runtime.envelope import unwrap_snapshot
+        assert unwrap_snapshot(b"{}") == (None, b"{}")
+        assert unwrap_snapshot(b"") == (None, b"")
+
 
 class TestNativeWAL:
     """The C++ write path (native/wal.cc) must be byte-identical to the
