@@ -40,7 +40,7 @@ Environment knobs:
   BENCH_PLATFORM cpu|tpu        (parent: single attempt on this platform)
   BENCH_ATTEMPT_TIMEOUT_S       (default 420, per child attempt)
   BENCH_PROBE_TIMEOUT_S         (default 150, platform probe)
-  BENCH_TOTAL_BUDGET_S          (default 2400, whole-parent wall budget)
+  BENCH_TOTAL_BUDGET_S          (default 1800, whole-parent wall budget)
   BENCH_SKIP_DURABLE=1 / BENCH_SKIP_SWEEP=1
   BENCH_PROFILE  <dir>          (wrap timed runs in jax.profiler.trace)
 """
@@ -379,6 +379,7 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
 
     from raftsql_tpu.config import RaftConfig
     from raftsql_tpu.models.kv_sm import KVStateMachine
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
     from raftsql_tpu.runtime.node import RaftNode
     from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
 
@@ -389,22 +390,43 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
     hub = LoopbackHub(codec=False)
     nodes = [RaftNode(i + 1, peers, cfg, LoopbackTransport(hub),
                       os.path.join(tmp, f"n{i + 1}")) for i in range(peers)]
-    sms = [KVStateMachine() for _ in range(groups)]     # node-1's replicas
-    applied = 0
+    # BENCH_SM=sqlite: the reference-parity apply engine (one SQLite
+    # database per group, group-committed) instead of the in-memory KV —
+    # the number then covers the FULL product stack.
+    sm_kind = os.environ.get("BENCH_SM", "kv")
+    if sm_kind == "sqlite":
+        sms = [SQLiteStateMachine(os.path.join(tmp, f"sm-{g}.db"))
+               for g in range(groups)]
+        for g, sm in enumerate(sms):
+            err = sm.apply("CREATE TABLE t (v text)", 0)
+            assert err is None, err
+        mk_cmd = "INSERT INTO t (v) VALUES ('x')"
+    else:
+        sms = [KVStateMachine() for _ in range(groups)]
+        mk_cmd = "SET k v"
 
     def drain(n0: "RaftNode", apply: bool) -> int:
         cnt = 0
+        per_g: dict = {}
         while True:
             try:
                 item = n0.commit_q.get_nowait()
             except Exception:
-                return cnt
+                break
             if item is None or not isinstance(item, tuple):
                 continue
             g, idx, cmd = item
             if apply:
-                sms[g].apply(cmd, idx)
+                per_g.setdefault(g, []).append((cmd, idx))
             cnt += 1
+        for g, items in per_g.items():
+            fn = getattr(sms[g], "apply_batch", None)
+            if fn is not None:
+                fn(items)
+            else:
+                for cmd, idx in items:
+                    sms[g].apply(cmd, idx)
+        return cnt
 
     try:
         for n in nodes:
@@ -428,7 +450,12 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
         best = 0.0
         for _ in range(repeats):
             # Pre-queue ticks*E proposals per group at its leader.
-            cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
+            # kv keeps the original unique-key workload (comparable to
+            # earlier recorded runs); sqlite uses one INSERT shape.
+            if sm_kind == "sqlite":
+                cmds = [mk_cmd.encode()] * (ticks * E)
+            else:
+                cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
             for g in range(groups):
                 h = int(hints[g])
                 nodes[h if h >= 0 else 0].propose_many(g, cmds)
@@ -526,7 +553,11 @@ def run_config(config: str, cpu: bool):
         return (sweep.get("light_1", {}).get("p50_ms") or 0.0,
                 {"lat": sweep})
     if config == "durable":
-        dg = int(os.environ.get("BENCH_GROUPS", 1000 if cpu else 10_000))
+        # sqlite keeps one DB file (3 fds with -wal/-shm) per group: stay
+        # well under the default open-files rlimit.
+        default_g = (256 if os.environ.get("BENCH_SM") == "sqlite"
+                     else 1000 if cpu else 10_000)
+        dg = int(os.environ.get("BENCH_GROUPS", default_g))
         dticks = int(os.environ.get("BENCH_TICKS", 24))
         return bench_durable(dg, peers, dticks, min(repeats, 2))
     # headline: saturated throughput + the latency/load sweep.
@@ -671,7 +702,7 @@ def main() -> None:
     # driver's own deadline and reproduce the round-1 rc=124/no-JSON
     # failure.  The fallback reserve guarantees the cpu headline always
     # has room to run.
-    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
+    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1800"))
     t_start = time.monotonic()
     fallback_reserve = timeout_s + 90
 

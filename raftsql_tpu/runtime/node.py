@@ -826,8 +826,19 @@ class RaftNode:
             c = int(commit[g])
             a = int(self._applied[g])
             fwd = self._fwd[g]
-            for idx in range(a + 1, c + 1):
-                data = self.payload_log.get(g, idx)
+            # One locked read for the whole newly-committed range — a
+            # per-entry get() pays a lock acquisition per entry, which
+            # dominated this phase at high commit rates.
+            datas = self.payload_log.slice(g, a + 1, c - a)
+            # Loud, not silent: a short read here means the host payload
+            # log diverged from the device commit (a sync bug) — skipping
+            # the missing committed entries would silently fork this
+            # replica's state machine.
+            assert len(datas) == c - a, (
+                f"g{g}: payload log shorter than commit "
+                f"({a}+{len(datas)} < {c})")
+            for off, data in enumerate(datas):
+                idx = a + 1 + off
                 if data and fwd:
                     # Forwarded proposal observed committed: retire it
                     # (exact match — envelope ids are unique).
