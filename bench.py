@@ -387,8 +387,26 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
     cfg = RaftConfig(num_groups=groups, num_peers=peers, log_window=64,
                      max_entries_per_msg=E, tick_interval_s=0.0)
     tmp = tempfile.mkdtemp(prefix="bench-durable-")
-    hub = LoopbackHub(codec=False)
-    nodes = [RaftNode(i + 1, peers, cfg, LoopbackTransport(hub),
+    # BENCH_TRANSPORT=tcp: peer traffic rides real localhost sockets
+    # through the binary codec — the DCN product path — instead of the
+    # in-process loopback.
+    if os.environ.get("BENCH_TRANSPORT") == "tcp":
+        import socket as _socket
+
+        from raftsql_tpu.transport.tcp import TcpTransport
+        socks, urls = [], []
+        for _ in range(peers):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            urls.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+        for s in socks:
+            s.close()
+        transports = [TcpTransport(urls, i) for i in range(peers)]
+    else:
+        hub = LoopbackHub(codec=False)
+        transports = [LoopbackTransport(hub) for _ in range(peers)]
+    nodes = [RaftNode(i + 1, peers, cfg, transports[i],
                       os.path.join(tmp, f"n{i + 1}")) for i in range(peers)]
     # BENCH_SM=sqlite: the reference-parity apply engine (one SQLite
     # database per group, group-committed) instead of the in-memory KV —
@@ -422,10 +440,12 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
         for g, items in per_g.items():
             fn = getattr(sms[g], "apply_batch", None)
             if fn is not None:
-                fn(items)
+                errs = fn(items)
             else:
-                for cmd, idx in items:
-                    sms[g].apply(cmd, idx)
+                errs = [sms[g].apply(cmd, idx) for cmd, idx in items]
+            bad = [e for e in errs if e is not None]
+            if bad:     # a commits/s number for failed applies is a lie
+                raise RuntimeError(f"apply failed in group {g}: {bad[0]}")
         return cnt
 
     try:
@@ -439,6 +459,10 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
             hints = np.asarray(nodes[0].state.leader_hint)
             if t > cfg.election_ticks and (hints >= 0).all():
                 break
+        for n in nodes:
+            if n.error is not None:   # e.g. a TCP bind lost to a racer
+                raise RuntimeError(f"node {n.node_id} died during "
+                                   f"warmup: {n.error}")
         hints = np.asarray(nodes[0].state.leader_hint)
         _log(f"  elected: {int((hints >= 0).sum())}/{groups} groups "
              f"after warmup")

@@ -128,6 +128,7 @@ class RaftNode:
         # cache as numpy arrays: every tick compares/updates ALL groups, so
         # these must be vectorized state, not per-group Python objects.
         self._applied = np.zeros(G, np.int64)
+        self._prev_role = np.zeros(G, np.int64)     # elections_won metric
         self._dedup = [DedupWindow() for _ in range(G)]
         self._hard_np = np.zeros((G, 3), np.int64)
         self._hard_np[:, 1] = NO_VOTE
@@ -389,6 +390,10 @@ class RaftNode:
         m.t_wal_ms += (t2 - t1) * 1e3
         m.t_send_ms += (t3 - t2) * 1e3
         m.t_publish_ms += (t4 - t3) * 1e3
+        role = np.asarray(info.role)
+        m.elections_won += int(((role == LEADER)
+                                & (self._prev_role != LEADER)).sum())
+        self._prev_role = role
         self._tick_no += 1
         m.ticks += 1
 
@@ -830,13 +835,14 @@ class RaftNode:
             # per-entry get() pays a lock acquisition per entry, which
             # dominated this phase at high commit rates.
             datas = self.payload_log.slice(g, a + 1, c - a)
-            # Loud, not silent: a short read here means the host payload
-            # log diverged from the device commit (a sync bug) — skipping
-            # the missing committed entries would silently fork this
-            # replica's state machine.
-            assert len(datas) == c - a, (
-                f"g{g}: payload log shorter than commit "
-                f"({a}+{len(datas)} < {c})")
+            # Loud, not silent (and not a stripable assert): a short read
+            # here means the host payload log diverged from the device
+            # commit (a sync bug) — skipping the missing committed
+            # entries would silently fork this replica's state machine.
+            if len(datas) != c - a:
+                raise RuntimeError(
+                    f"g{g}: payload log shorter than commit "
+                    f"({a}+{len(datas)} < {c})")
             for off, data in enumerate(datas):
                 idx = a + 1 + off
                 if data and fwd:

@@ -18,6 +18,7 @@ class NodeMetrics:
     proposals: int = 0
     commits: int = 0
     msgs_sent: int = 0
+    elections_won: int = 0
     catchup_appends: int = 0
     compactions: int = 0
     snapshots_sent: int = 0
@@ -40,6 +41,7 @@ class NodeMetrics:
             "proposals": self.proposals,
             "commits": self.commits,
             "msgs_sent": self.msgs_sent,
+            "elections_won": self.elections_won,
             "catchup_appends": self.catchup_appends,
             "compactions": self.compactions,
             "snapshots_sent": self.snapshots_sent,

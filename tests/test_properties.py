@@ -130,6 +130,43 @@ class TestSafetyUnderChaos:
                               partition_schedule=[(40, 80, 2)], seed=6)
         assert (np.asarray(states.commit).max(axis=0) > 0).all()
 
+    def test_invariants_long_horizon_mixed_faults(self):
+        """250 ticks of drops + a flapping partition: long enough for
+        multiple prevote probe cycles, pipelined backlogs, and reject
+        walkbacks to interleave (the round-3 additions)."""
+        cfg = RaftConfig(seed=8, **CFG)
+        sched = [(40, 70, 1), (90, 120, 0), (140, 170, 1), (190, 220, 2)]
+        states, _ = run_chaos(cfg, 250, p_drop=0.2,
+                              partition_schedule=sched, seed=8)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+    def test_invariants_asymmetric_loss(self):
+        """One peer's outbound messages drop per-message at 60% while
+        inbound flow stays clean — the shape that provokes stale-leader/
+        stale-term traffic and the inflight-cap resend path."""
+        from raftsql_tpu.transport.faults import drop_messages
+
+        cfg = RaftConfig(seed=9, **CFG)
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        checker = InvariantChecker(cfg)
+        rng = np.random.default_rng(9)
+        key = jax.random.PRNGKey(10)
+        shape = inboxes.v_type.shape        # [P_dst, G, P_src]
+        for t in range(200):
+            if 40 <= t < 160:
+                key, sub = jax.random.split(key)
+                drop = jnp.zeros(shape, bool).at[:, :, 1].set(
+                    jax.random.bernoulli(sub, 0.6, shape[:-1]))
+                inboxes = drop_messages(inboxes, drop)
+            props = jnp.asarray(
+                (rng.random((cfg.num_peers, cfg.num_groups)) < 0.3)
+                .astype(np.int32))
+            states, inboxes, _ = cluster_step_jit(cfg, states, inboxes,
+                                                  props)
+            checker.check(states, t)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
     def test_committed_entries_survive_leader_churn(self):
         # Partition whoever leads group 0, twice; committed data must persist.
         cfg = RaftConfig(seed=7, **CFG)

