@@ -11,6 +11,12 @@ Mirrors the reference's httpSQLAPI (reference httpapi.go:26-79):
 
 Extensions beyond the reference (multi-group engine):
   - `X-Raft-Group` header selects the raft group (default 0);
+  - `X-Consistency: linear` on GET upgrades the read to LINEARIZABLE
+    (ReadIndex, raft §6.4): served only by the group's leader after a
+    quorum re-confirms its leadership and the local apply catches up to
+    the read point; non-leaders answer 421 + `X-Raft-Leader` so the
+    client can retry at the leader.  Plain GETs stay reference-parity
+    stale local reads;
   - `GET /metrics` returns node counters as JSON (SURVEY.md §5.5).
 """
 from __future__ import annotations
@@ -20,7 +26,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from raftsql_tpu.runtime.db import RaftDB
+from raftsql_tpu.runtime.db import NotLeaderError, RaftDB
 
 log = logging.getLogger("raftsql_tpu.http")
 
@@ -40,8 +46,11 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             return int(self.headers.get("X-Raft-Group") or 0)
 
         def _send(self, code: int, body: bytes = b"",
-                  ctype: str = "text/plain; charset=utf-8") -> None:
+                  ctype: str = "text/plain; charset=utf-8",
+                  headers: Optional[dict] = None) -> None:
             self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             if body or code != 204:
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -81,7 +90,22 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                            ctype="application/json")
                 return
             try:
-                rows = rdb.query(self._body(), self._group())
+                linear = (self.headers.get("X-Consistency", "")
+                          .lower() == "linear")
+                rows = rdb.query(self._body(), self._group(),
+                                 linear=linear, timeout=timeout_s)
+            except NotLeaderError as e:
+                # 421 Misdirected Request + the leader hint: the client
+                # retries its linearizable read against that node.
+                self._send(421, (str(e) + "\n").encode("utf-8"),
+                           headers={"X-Raft-Leader": str(e.leader)}
+                           if e.leader > 0 else None)
+                return
+            except TimeoutError as e:
+                # Transient server-side condition (quorum unreachable or
+                # apply lagging) — retryable, NOT a client error.
+                self._send(503, (str(e) + "\n").encode("utf-8"))
+                return
             except Exception as e:
                 self._err(e)
                 return

@@ -41,6 +41,18 @@ from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
 
+class NotLeaderError(Exception):
+    """A linearizable read hit a non-leader; retry at `leader` (1-based
+    node id, 0 = unknown)."""
+
+    def __init__(self, group: int, leader: int):
+        super().__init__(
+            f"group {group}: not the leader"
+            + (f"; leader is node {leader}" if leader > 0 else ""))
+        self.group = group
+        self.leader = leader
+
+
 class AckFuture:
     """The reference's buffered `chan error` (db.go:107): one result,
     delivered once, awaited by one client."""
@@ -310,13 +322,52 @@ class RaftDB:
             if not cbs:
                 del self._q2cb[(group, query)]
 
-    def query(self, query: str, group: int = 0) -> str:
-        """Local read — never touches consensus (db.go:123-130)."""
+    def query(self, query: str, group: int = 0,
+              linear: bool = False, timeout: float = 10.0) -> str:
+        """Local read — never touches consensus (db.go:123-130).
+
+        linear=True upgrades to a LINEARIZABLE read (ReadIndex, raft
+        §6.4 — a capability the reference lacks): only the group's
+        current leader serves it, after (a) a quorum re-confirms its
+        leadership on a round started after this call and (b) the local
+        state machine has applied everything committed at call time.
+        Raises NotLeaderError (with the last known leader) elsewhere."""
         if not is_select(query):
             raise ValueError("expected SELECT")
         if not 0 <= group < self.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.num_groups})")
+        if linear:
+            node = self.pipe.node
+            tick = node.cfg.tick_interval_s or 0.001
+            deadline = time.monotonic() + timeout
+            while True:
+                got = node.read_index(group)
+                if got is None:
+                    raise NotLeaderError(group, node.leader_of(group) + 1)
+                if got != ():
+                    break
+                # Leader without a committed current-term entry yet
+                # (raft §6.4 precondition) — its no-op is in flight.
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"group {group}: no current-term commit yet")
+                time.sleep(tick)
+            target, reg = got
+            while not node.read_ready(group, reg):
+                if node.read_index(group) is None:
+                    raise NotLeaderError(group, node.leader_of(group) + 1)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"group {group}: leadership not re-confirmed "
+                        "(no quorum reachable?)")
+                time.sleep(tick)
+            while self._sms[group].applied_index() < target:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"group {group}: apply lagging read index "
+                        f"{target}")
+                time.sleep(tick)
         return self._sms[group].query(query)
 
     def metrics(self) -> dict:

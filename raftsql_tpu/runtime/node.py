@@ -38,8 +38,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, NO_VOTE,
-                                RaftConfig)
+from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP,
+                                NO_VOTE, RaftConfig)
 from raftsql_tpu.core.state import (Inbox, install_snapshot_state,
                                     restore_peer_state, set_peer_progress)
 from raftsql_tpu.core.step import peer_step_jit
@@ -129,6 +129,19 @@ class RaftNode:
         # these must be vectorized state, not per-group Python objects.
         self._applied = np.zeros(G, np.int64)
         self._prev_role = np.zeros(G, np.int64)     # elections_won metric
+        # ReadIndex state (raft §6.4).  Confirmations are bound to
+        # request ROUNDS: every append REQ carries this node's tick
+        # number (seq); responses echo it.  _resp_echo[g, p] is the
+        # newest echoed seq from peer p and _resp_term the term it
+        # responded at — a read registered at tick R is quorum-confirmed
+        # once enough peers echoed seq >= R at our current term, so a
+        # DELAYED pre-registration response can never count.  Role/hint
+        # are per-tick host caches (device state is donated; client
+        # threads must not touch it).
+        self._resp_echo = np.zeros((G, num_nodes), np.int64)
+        self._resp_term = np.zeros((G, num_nodes), np.int64)
+        self._last_role = np.zeros(G, np.int64)
+        self._last_hint = np.full(G, -1, np.int64)
         self._dedup = [DedupWindow() for _ in range(G)]
         self._hard_np = np.zeros((G, 3), np.int64)
         self._hard_np[:, 1] = NO_VOTE
@@ -248,8 +261,63 @@ class RaftNode:
         return payload.decode("utf-8")
 
     def leader_of(self, group: int) -> int:
-        """Last known leader (0-based peer), -1 if unknown."""
-        return int(np.asarray(self.state.leader_hint)[group])
+        """Last known leader (0-based peer), -1 if unknown.
+
+        Served from the host-side per-tick cache: `self.state` is DONATED
+        to the jitted step every tick, so touching the live device array
+        from a client thread races buffer invalidation ("Array has been
+        deleted")."""
+        return int(self._last_hint[group])
+
+    # ------------------------------------------------------------------
+    # linearizable reads (ReadIndex, raft §6.4 — beyond the reference's
+    # stale-local-read model, db.go:128-130)
+
+    def read_index(self, group: int):
+        """Register a linearizable read.
+
+        Returns (target_index, registration_tick) when this node leads
+        the group AND its commit covers an entry of its CURRENT term —
+        raft §6.4's precondition: a fresh leader's commit index may
+        still trail entries an earlier leader acked, until its own
+        no-op commits.  Returns () when leading but that precondition
+        is pending (caller should poll), or None when not leading
+        (caller should redirect to `leader_of`)."""
+        if self._last_role[group] != LEADER:
+            return None
+        commit = int(self._hard_np[group, 2])
+        term = int(self._hard_np[group, 0])
+        # try_term_of: this runs on CLIENT threads racing the tick thread
+        # and the compactor — a stale commit cache below the compaction
+        # floor must degrade to "retry", not an assertion.
+        if commit < 1 \
+                or self.payload_log.try_term_of(group, commit) != term:
+            return ()
+        # The read's target is the leader's current commit index; the
+        # quorum round that follows confirms no newer leader could have
+        # committed past it before registration.  reg = tick_no + 1:
+        # only rounds SENT strictly after this registration may confirm
+        # it (a send earlier in the in-flight tick predates the commit
+        # snapshot just taken).
+        return commit, self._tick_no + 1
+
+    def read_ready(self, group: int, reg_tick: int) -> bool:
+        """True once a quorum confirmed our leadership on rounds STARTED
+        at/after the registration: peers must have echoed a request seq
+        >= reg_tick while at our current term.  Echo binding (not tick
+        arithmetic) means a response delayed in flight from before the
+        registration can never count.
+
+        The (echo, term) pair is written under _stage_lock; reading
+        under the same lock keeps the pairing consistent — a torn read
+        could pair a new rejection's seq with the previous echo's term
+        and count a deposing peer as a confirmation."""
+        term = int(self._hard_np[group, 0])
+        with self._stage_lock:
+            echo = self._resp_echo[group].copy()
+            rterm = self._resp_term[group].copy()
+        ok = (echo >= reg_tick) & (rterm == term)
+        return int(ok.sum()) + 1 >= self.cfg.quorum
 
     # ------------------------------------------------------------------
     # log compaction (snapshot-resume mode, SURVEY.md §5.4 improvement)
@@ -320,6 +388,12 @@ class RaftNode:
                 if 0 <= a.group < G and a.n <= E \
                         and len(a.payloads) in (0, a.n):
                     self._stage_apps[(a.group, src0)] = a
+                    if a.type == MSG_RESP and a.seq:
+                        # ReadIndex round bookkeeping: newest request-seq
+                        # this peer has answered, and at what term.
+                        if a.seq > self._resp_echo[a.group, src0]:
+                            self._resp_echo[a.group, src0] = a.seq
+                            self._resp_term[a.group, src0] = a.term
             for s in batch.snapshots:
                 if 0 <= s.group < G:
                     old = self._stage_snaps.get(s.group)
@@ -394,6 +468,8 @@ class RaftNode:
         m.elections_won += int(((role == LEADER)
                                 & (self._prev_role != LEADER)).sum())
         self._prev_role = role
+        self._last_role = role
+        self._last_hint = np.asarray(info.leader_hint)
         self._tick_no += 1
         m.ticks += 1
 
@@ -673,7 +749,8 @@ class RaftNode:
                 prev_idx=ni - 1, prev_term=prev_term,
                 ent_terms=[t for (t, _) in ents],
                 payloads=[p for (_, p) in ents],
-                commit=min(int(commit[g]), ni - 1 + len(ents)))
+                commit=min(int(commit[g]), ni - 1 + len(ents)),
+                seq=self._tick_no)
             self.metrics.catchup_appends += 1
         return out
 
@@ -736,13 +813,20 @@ class RaftNode:
                     payloads = self.payload_log.try_slice(g, prev + 1, n)
                     if payloads is None:
                         continue
+                    seq = self._tick_no
                 else:
                     payloads = []
+                    # Echo the seq of the request this response answers
+                    # (the device consumed exactly the staged slot from
+                    # dst d this tick) — ReadIndex round binding.
+                    req = self._tick_apps.get((g, d))
+                    seq = req.seq if req is not None else 0
                 batch_for(d).appends.append(AppendRec(
                     group=g, type=mtype, term=tm,
                     prev_idx=prev, prev_term=pt,
                     ent_terms=a_ents_rows[i, :n].tolist(),
-                    payloads=payloads, commit=cm, success=su, match=ma))
+                    payloads=payloads, commit=cm, success=su, match=ma,
+                    seq=seq))
         for (g, d), cu in catchups.items():
             if (g, d) in emitted:
                 # The device emitted a (response) message for this slot;

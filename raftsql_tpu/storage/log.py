@@ -91,6 +91,22 @@ class PayloadLog:
             assert index > s, f"term_of below compaction floor ({index})"
             return self._logs[group][index - 1 - s][0]
 
+    def try_term_of(self, group: int, index: int) -> Optional[int]:
+        """term_of with a floor check instead of an assert: None when
+        `index` sits at/below a concurrently advancing compaction floor
+        or beyond the log — for client-thread callers (ReadIndex) that
+        race the compactor and must degrade to a retry, not an
+        AssertionError (or a wrapped negative index under python -O)."""
+        with self._mu:
+            if index == 0:
+                return 0
+            s = self._start[group]
+            if index == s:
+                return self._start_term[group]
+            if index < s or index > s + len(self._logs[group]):
+                return None
+            return self._logs[group][index - 1 - s][0]
+
     def try_tail_with_terms(self, group: int, start: int, n: int):
         """Atomic (prev_term, [(term, payload)...]) for entries
         [start, start+n) — None if `start` has been compacted away.

@@ -44,6 +44,12 @@ class AppendRec:
     commit: int = 0
     success: bool = False   # response fields
     match: int = 0
+    # Round binding for ReadIndex (raft §6.4): a REQ carries the sender's
+    # tick number; the RESP echoes the seq of the request it answers, so
+    # a leadership confirmation can be tied to rounds STARTED after a
+    # read registration (a delayed pre-registration response must not
+    # count — runtime/node.py read_ready).
+    seq: int = 0
 
     @property
     def n(self) -> int:

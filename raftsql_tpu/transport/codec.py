@@ -11,7 +11,7 @@ Frame := u32 n_votes  | VoteRec*
          u32 n_snaps  | SnapshotRec*
 VoteRec     := u32 group | u8 type | q term | q last_idx | q last_term | u8 granted
 AppendRec   := u32 group | u8 type | q term | q prev_idx | q prev_term
-             | q commit | u8 success | q match | u16 n
+             | q commit | u8 success | q match | q seq | u16 n
              | q ent_term * n | (u32 len | bytes) * n_payloads(=n for REQ, 0 resp)
 ProposalRec := u32 group | u32 len | bytes
 SnapshotRec := u32 group | q last_idx | q last_term | q term | u32 len | bytes
@@ -27,7 +27,7 @@ from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
 
 _U32 = struct.Struct("<I")
 _VOTE = struct.Struct("<IBqqqB")
-_APP = struct.Struct("<IBqqqqBqH")
+_APP = struct.Struct("<IBqqqqBqqH")
 _PLEN = struct.Struct("<I")
 _SNAP = struct.Struct("<Iqqq")
 
@@ -41,7 +41,7 @@ def encode_batch(batch: TickBatch) -> bytes:
     for a in batch.appends:
         out.append(_APP.pack(a.group, a.type, a.term, a.prev_idx,
                              a.prev_term, a.commit, int(a.success), a.match,
-                             len(a.ent_terms)))
+                             a.seq, len(a.ent_terms)))
         out.append(struct.pack(f"<{len(a.ent_terms)}q", *a.ent_terms))
         if a.type == MSG_REQ:
             assert len(a.payloads) == len(a.ent_terms), \
@@ -79,7 +79,7 @@ def decode_batch(blob: bytes) -> TickBatch:
                                    last_term=lt, granted=bool(gr)))
     (na,) = take(_U32)
     for _ in range(na):
-        g, t, term, pi, pt, cm, su, ma, n = take(_APP)
+        g, t, term, pi, pt, cm, su, ma, seq, n = take(_APP)
         terms = list(struct.unpack_from(f"<{n}q", blob, off))
         off += 8 * n
         payloads: List[bytes] = []
@@ -92,7 +92,7 @@ def decode_batch(blob: bytes) -> TickBatch:
         batch.appends.append(AppendRec(
             group=g, type=t, term=term, prev_idx=pi, prev_term=pt,
             ent_terms=terms, payloads=payloads, commit=cm,
-            success=bool(su), match=ma))
+            success=bool(su), match=ma, seq=seq))
     (np_,) = take(_U32)
     for _ in range(np_):
         (g,) = take(_U32)
