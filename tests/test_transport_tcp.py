@@ -28,7 +28,7 @@ def sample_batch() -> TickBatch:
                        granted=True)],
         appends=[AppendRec(group=1, type=1, term=7, prev_idx=9, prev_term=6,
                            ent_terms=[7, 7], payloads=[b"a", b"bb"],
-                           commit=8)],
+                           commit=8, seq=41)],
         proposals=[ProposalRec(group=0, payload=b"INSERT")],
         snapshots=[SnapshotRec(group=2, last_idx=11, last_term=5, term=7,
                                blob=b"\x00blob")])
