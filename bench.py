@@ -157,10 +157,14 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
     full = jnp.full((cfg.num_peers, cfg.num_groups), load, jnp.int32)
 
     run = make_bench_run(cfg, ticks)
-    warm = make_bench_run(cfg, 4 * cfg.election_ticks)
 
-    # Warmup: elect leaders everywhere + trigger both compiles.
-    states, inboxes, _, _, _ = warm(states, inboxes, full * 0)
+    # Warmup (elect leaders everywhere) reuses the RUN program at zero
+    # load — a separate shorter-scan warmup program would cost a second
+    # full compile, which on the remote-TPU tunnel can dominate the
+    # child's time budget.  Repeat for short runs so every group gets at
+    # least ~4 election intervals to settle.
+    for _ in range(max(1, -(-4 * cfg.election_ticks // ticks))):
+        states, inboxes, _, _, _ = run(states, inboxes, full * 0)
     states, inboxes, c, _, _ = run(states, inboxes, full)
     jax.block_until_ready(c)
 
