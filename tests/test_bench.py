@@ -1,0 +1,63 @@
+"""Benchmark-harness smoke tests (SURVEY.md §4 lists "no benchmark
+tests" among the reference's gaps to close): a micro-scale bench child
+must produce a well-formed result with nonzero commits, and the parent's
+JSON contract must hold even when everything fails.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_extra, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout, cwd=REPO)
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr tail: {r.stderr[-800:]}"
+    return r, json.loads(lines[-1])
+
+
+def test_headline_child_micro():
+    r, out = run_bench({
+        "BENCH_CHILD": "1", "BENCH_PLATFORM": "cpu", "BENCH_GROUPS": "64",
+        "BENCH_TICKS": "20", "BENCH_REPEATS": "1", "BENCH_SKIP_SWEEP": "1",
+        "BENCH_E": "8"})
+    assert r.returncode == 0, r.stderr[-800:]
+    assert out["metric"] == "raft_commits_per_sec"
+    assert out["unit"] == "commits/s"
+    assert out["value"] > 0
+    assert out["platform"] == "cpu"
+    # Pipelined replication: the marked batch commits in ~3 ticks.
+    assert out.get("p50_sat_ms") is not None
+
+
+def test_durable_child_micro():
+    r, out = run_bench({
+        "BENCH_CHILD": "1", "BENCH_PLATFORM": "cpu",
+        "BENCH_CONFIG": "durable", "BENCH_GROUPS": "32",
+        "BENCH_TICKS": "8", "BENCH_REPEATS": "1"})
+    assert r.returncode == 0, r.stderr[-800:]
+    assert out["value"] > 0
+    phases = out["durable_phase_ms"]
+    assert set(phases) == {"stage", "device", "wal", "send", "publish"}
+
+
+def test_parent_emits_json_when_all_attempts_fail():
+    """The driver contract: ONE parseable JSON line and exit 0, no
+    matter what.  BENCH_GROUPS=-1 makes every measurement child die in
+    RaftConfig validation (and short timeouts kill wedged probes), so
+    the parent must reach its emergency platform="none" emit."""
+    r, out = run_bench({
+        "BENCH_PROBE_TIMEOUT_S": "3", "BENCH_ATTEMPT_TIMEOUT_S": "30",
+        "BENCH_TOTAL_BUDGET_S": "90", "BENCH_SKIP_DURABLE": "1",
+        "BENCH_SKIP_SWEEP": "1", "BENCH_GROUPS": "-1",
+        "BENCH_TICKS": "20", "BENCH_REPEATS": "1", "BENCH_E": "8"},
+        timeout=480)
+    assert r.returncode == 0
+    assert out["metric"] == "raft_commits_per_sec"
+    assert out["platform"] == "none"
+    assert out["value"] == 0.0

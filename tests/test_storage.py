@@ -302,6 +302,21 @@ class TestEnvelope:
         assert unwrap_snapshot(b"") == (None, b"")
 
 
+class TestPayloadLog:
+    def test_try_term_of(self):
+        """Floor-safe term lookup for client-thread callers (ReadIndex):
+        below-floor and beyond-log return None, never an assert/wrap."""
+        from raftsql_tpu.storage.log import PayloadLog
+        pl = PayloadLog(1)
+        pl.put(0, 1, [b"a", b"b", b"c", b"d"], [1, 1, 2, 2])
+        assert pl.try_term_of(0, 0) == 0
+        assert pl.try_term_of(0, 3) == 2
+        assert pl.try_term_of(0, 5) is None       # beyond the log
+        pl.compact(0, 2, 1)
+        assert pl.try_term_of(0, 2) == 1          # boundary term kept
+        assert pl.try_term_of(0, 1) is None       # below the floor
+
+
 class TestNativeWAL:
     """The C++ write path (native/wal.cc) must be byte-identical to the
     Python writer and fully interoperable with Python replay."""
