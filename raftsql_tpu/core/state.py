@@ -284,10 +284,15 @@ def term_at(log_term: jax.Array, log_len: jax.Array, idx: jax.Array,
     controller guarantees the engine never asks for those (see
     runtime/node.py flow control and config.log_window).
     """
+    # ops.dense.take_last: on TPU this lowers to a fused one-hot
+    # select-reduce instead of an XLA gather (which serializes per index
+    # on that backend — see ops/dense.py).
+    from raftsql_tpu.ops.dense import take_last
+
     idx = jnp.asarray(idx)
     squeeze = idx.ndim == log_term.ndim - 1
     idx2 = idx[..., None] if squeeze else idx
-    got = jnp.take_along_axis(log_term, (idx2 - 1) % window, axis=-1)
+    got = take_last(log_term, (idx2 - 1) % window)
     if squeeze:
         got = got[..., 0]
     else:

@@ -38,6 +38,7 @@ from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_NONE,
                                 NO_LEADER, NO_VOTE, PRECANDIDATE, RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     term_at)
+from raftsql_tpu.ops import dense
 from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
 
 
@@ -71,7 +72,6 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     quorum = cfg.quorum
     src_ids = jnp.arange(P, dtype=I32)[None, :]                  # [1, P]
     self_onehot = src_ids == self_id                             # [1, P]
-    grows = jnp.arange(G)[:, None]                               # [G, 1]
 
     log_term, log_len = state.log_term, state.log_len
     commit0 = state.commit
@@ -173,8 +173,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     leader_hint = jnp.where(any_app, asrc, leader_hint)
 
     def pick(x):  # gather the chosen source's message fields → [G, ...]
-        return jnp.take_along_axis(
-            x, asrc.reshape((G,) + (1,) * (x.ndim - 1)), axis=1)[:, 0]
+        return dense.pick_peer(x, asrc)
 
     prev = pick(inbox.a_prev_idx)
     prev_t = pick(inbox.a_prev_term)
@@ -194,14 +193,27 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
                              & (term_at(log_term, log_len, prev, W) == prev_t))
     accept = any_app & prev_ok & (role != LEADER)
 
-    ent_pos = prev[:, None] + 1 + jnp.arange(E, dtype=I32)[None, :]  # [G, E]
-    in_batch = jnp.arange(E, dtype=I32)[None, :] < a_n[:, None]
-    existing = term_at(log_term, log_len, ent_pos, W)
-    conflict = (accept[:, None] & in_batch & (ent_pos <= log_len[:, None])
-                & (existing != a_ents)).any(-1)
-    wmask = accept[:, None] & in_batch
-    wslot = jnp.where(wmask, (ent_pos - 1) % W, W)   # W = out-of-bounds drop
-    log_term = log_term.at[grows, wslot].set(a_ents, mode='drop')
+    # Conflict detection at the ENDPOINT only: the batch and our log agree
+    # at prev (prev_ok), and by the Log Matching property two raft logs
+    # that share (index, term) at any position are identical up through
+    # it — so if the LAST overlapping position carries matching terms, so
+    # does every earlier one, and a mismatch anywhere implies one at the
+    # endpoint.  One [G] ring read replaces the [G, E]-wide per-position
+    # scan (which profiled as 34% of the TPU tick, see ops/dense.py).
+    ov_n = jnp.clip(jnp.minimum(prev + a_n, log_len) - prev, 0, E)  # [G]
+    ov_term = term_at(log_term, log_len, prev + ov_n, W)
+    batch_ov = dense.pick_batch(a_ents, jnp.maximum(ov_n - 1, 0))
+    conflict = accept & (ov_n > 0) & (ov_term != batch_ov)
+    # Ring write of the accepted batch, scatter-free (ops/dense.py): entry
+    # e lands at slot (prev+e) % W, so slot w holds batch element
+    # (w - prev) mod W when that is < n.  One-hot over E replaces the
+    # serialized XLA scatter the TPU path cannot afford.
+    a_n_w = jnp.clip(a_n, 0, E)
+    wpos = jnp.arange(W, dtype=I32)[None, :]                       # [1, W]
+    rel4 = (wpos - prev[:, None]) % W                              # [G, W]
+    hit4 = accept[:, None] & (rel4 < a_n_w[:, None])
+    vals4 = dense.ring_gather_values(a_ents, rel4, a_n_w)
+    log_term = jnp.where(hit4, vals4, log_term)
     app_end = prev + a_n
     follower_len0 = log_len
     log_len = jnp.where(
@@ -242,11 +254,12 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
                       jnp.minimum(jnp.minimum(prop_n, E), space - noop_n), 0)
     total_app = noop_n + n_acc
     prop_base = log_len + noop_n
-    app_pos = log_len[:, None] + 1 + jnp.arange(E + 1, dtype=I32)[None, :]
-    pmask = jnp.arange(E + 1, dtype=I32)[None, :] < total_app[:, None]
-    pslot = jnp.where(pmask, (app_pos - 1) % W, W)
-    log_term = log_term.at[grows, pslot].set(
-        jnp.broadcast_to(term[:, None], (G, E + 1)), mode='drop')
+    # Appended entries all carry the leader's current term, so this ring
+    # write is a pure mask fill (no scatter, no value gather): slot w is
+    # written iff (w - log_len) mod W < total_app, i.e. it holds one of
+    # positions log_len+1 .. log_len+total_app.
+    rel6 = (wpos - log_len[:, None]) % W                           # [G, W]
+    log_term = jnp.where(rel6 < total_app[:, None], term[:, None], log_term)
     log_len = log_len + total_app
     match = jnp.where(is_leader[:, None] & self_onehot, log_len[:, None],
                       match)
@@ -291,12 +304,14 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
                           jnp.broadcast_to(self_onehot, (G, P)), votes)
     leader_hint = jnp.where(fire, NO_LEADER, leader_hint)
     elapsed = jnp.where(fire, 0, elapsed)
-    key = jax.random.fold_in(state.rng, state.tick)
+    # Per-group timeout re-draw via an integer hash (ops/dense.py): the
+    # threefry chain this replaces (~40 HLOs) dominated tick wall time on
+    # the TPU path; the hash keeps the same contract (deterministic in
+    # seed/peer/tick/global gid, uniform over the span).
     gids = jnp.asarray(group_offset, I32) + jnp.arange(G, dtype=I32)
-    new_timeout = jax.vmap(
-        lambda g: jax.random.randint(
-            jax.random.fold_in(key, g), (),
-            cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32))(gids)
+    new_timeout = dense.election_jitter(
+        dense.key_data_of(state.rng), state.tick, gids,
+        cfg.election_ticks, 2 * cfg.election_ticks)
     timeout = jnp.where(fire, new_timeout, state.timeout)
 
     hb = jnp.where(is_leader, state.hb_elapsed + 1, 0)

@@ -1,0 +1,122 @@
+"""Backend-adaptive dense gather/scatter primitives for the hot tick.
+
+XLA lowers `gather`/`scatter` with data-dependent indices to serialized
+per-element updates on TPU, and the consensus step (core/step.py) is built
+almost entirely of small ring reads/writes with such indices: profiled
+through the single-chip TPU path, each gather/scatter HLO costs ~2 ms while
+the equivalent mask-select-reduce costs ~1 µs (the step carried 12 gathers
++ 4 scatters ≈ 50 ms/tick).  The replacement formulation is TPU-first:
+
+  read:   out[..., x] = Σ_w  where(idx[..., x] == w, src[..., w], 0)
+  write:  dst[..., w] = where(hit[..., w], val[..., w], dst[..., w])
+
+i.e. one-hot comparisons fused by XLA into elementwise+reduce — no
+serialization, no dynamic indexing.  On CPU the native gather IS the fast
+path (vectorized memcpy-like), so `take_last` picks per backend at trace
+time; `RAFTSQL_DENSE=0/1` overrides it (tests/test_ops.py runs the core
+equivalence checks on both paths).
+
+The election jitter here replaces `jax.random.fold_in`+`randint` (threefry
+is ~40 xor/shift/mul HLOs per tick, ~2 ms through the same path) with a
+splitmix-style integer hash: deterministic in (key, tick, global group id),
+uniform over the timeout span, and a handful of elementwise uint32 ops.
+
+This module replaces nothing in the reference — it is the TPU-native cost
+model asserting itself where etcd/raft (reference raft.go:30) used ordinary
+pointer-chasing Go.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+
+def use_dense() -> bool:
+    """Trace-time choice: one-hot dense ops (TPU) vs native gather (CPU)."""
+    ov = os.environ.get("RAFTSQL_DENSE")
+    if ov is not None:
+        return ov == "1"
+    return jax.default_backend() != "cpu"
+
+
+def onehot_take(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """out[..., i] = x[..., idx[..., i]] as a one-hot select-reduce.
+
+    x: [..., W]; idx: [..., X] int in [0, W) — out-of-range indices
+    contribute 0.  The shared core of every dense read below.
+    """
+    W = x.shape[-1]
+    hit = idx[..., None] == jnp.arange(W, dtype=idx.dtype)      # [..., X, W]
+    return jnp.sum(jnp.where(hit, x[..., None, :], 0), axis=-1)
+
+
+def take_last(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """`take_along_axis(x, idx, axis=-1)`, gather-free on TPU.
+
+    x: [..., W]; idx: [..., X] int32 in [0, W) -> [..., X].
+    """
+    if not use_dense():
+        return jnp.take_along_axis(x, idx, axis=-1)
+    return onehot_take(x, idx)
+
+
+def pick_peer(x: jax.Array, src: jax.Array) -> jax.Array:
+    """x[g, src[g]] for x of shape [G, P, ...] — one-hot over the small P
+    axis on every backend (P is 3-5; a gather would serialize G rows on
+    TPU while the select-reduce is a handful of fused lanes).  Trailing
+    message dims keep onehot_take from applying directly."""
+    G, P = x.shape[0], x.shape[1]
+    sel = jnp.arange(P, dtype=src.dtype)[None, :] == src[:, None]   # [G, P]
+    m = sel.reshape((G, P) + (1,) * (x.ndim - 2))
+    return jnp.sum(jnp.where(m, x, 0), axis=1)
+
+
+def pick_batch(vals: jax.Array, idx: jax.Array) -> jax.Array:
+    """vals[g, idx[g]] for vals of shape [G, E] — one-hot over the small E
+    axis (same rationale as pick_peer)."""
+    return onehot_take(vals, idx[:, None])[:, 0]
+
+
+def ring_gather_values(vals: jax.Array, rel: jax.Array, n: jax.Array
+                       ) -> jax.Array:
+    """Per-slot batch values for a ring write: out[g, w] = vals[g, rel[g, w]]
+    where rel[g, w] < n[g], else 0.
+
+    vals: [G, E]; rel: [G, W] int32; n: [G] (clamped to E by the caller).
+    """
+    E = vals.shape[-1]
+    live = rel < n[:, None]                                     # [G, W]
+    if not use_dense():
+        got = jnp.take_along_axis(vals, jnp.minimum(rel, E - 1), axis=-1)
+        return jnp.where(live, got, 0)
+    return jnp.where(live, onehot_take(vals, rel), 0)
+
+
+def election_jitter(key_data: jax.Array, tick: jax.Array, gids: jax.Array,
+                    lo: int, hi: int) -> jax.Array:
+    """Per-group timeout draw in [lo, hi) — splitmix32-style finalizer over
+    (key, tick, global group id).  Matches the contract of the
+    fold_in+randint draw it replaces (core/step.py Phase 8): deterministic
+    per (seed, peer, tick, GLOBAL gid), so mesh-sharded peers draw
+    bit-identical jitter to the single-chip run.
+    """
+    kd = key_data.reshape(-1).astype(U32)
+    x = (gids.astype(U32) * U32(0x9E3779B1)
+         ^ tick.astype(U32) * U32(0x85EBCA77)
+         ^ kd[0] * U32(0xC2B2AE3D) ^ kd[-1])
+    x = (x ^ (x >> 16)) * U32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * U32(0x846CA68B)
+    x = x ^ (x >> 16)
+    span = max(hi - lo, 1)
+    return (U32(lo) + x % U32(span)).astype(jnp.int32)
+
+
+def key_data_of(rng: jax.Array) -> jax.Array:
+    """Raw uint32 words of a PRNG key, old-style ([2] uint32) or typed."""
+    if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(rng)
+    return rng

@@ -69,12 +69,18 @@ class InvariantChecker:
                 c = int(commit[p, g])
                 assert c <= log_len[p, g]
                 pterms = terms[p, g, :c].tolist()
+                # The device ring only holds the last W entries: position
+                # i's slot is recycled by position i+W once log_len passes
+                # it, so terms read for positions <= log_len - W are
+                # aliased garbage, not engine state.  Check (and extend
+                # history) only over ring-observable positions.
+                floor = max(0, int(log_len[p, g]) - cfg.log_window)
                 overlap = min(len(hist), c)
-                assert hist[:overlap] == pterms[:overlap], (
+                assert hist[floor:overlap] == pterms[floor:overlap], (
                     f"t={t} g={g} p={p}: committed prefix diverged: "
-                    f"{hist[:overlap]} vs {pterms[:overlap]}")
-                if c > len(hist):
-                    self.committed[g] = pterms
+                    f"{hist[floor:overlap]} vs {pterms[floor:overlap]}")
+                if c > len(hist) and len(hist) >= floor:
+                    self.committed[g] = hist + pterms[len(hist):c]
 
 
 def run_chaos(cfg, ticks, p_drop=0.0, partition_schedule=(), prop_rate=0.3,
