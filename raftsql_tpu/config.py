@@ -83,6 +83,16 @@ class RaftConfig:
     # here because one "message" is an E-entry batch re-sent every tick.
     max_inflight_msgs: int = 4
 
+    # K: capacity of the per-group term-transition table (core/state.py
+    # tbl_pos/tbl_term).  Terms are monotone along a raft log and change
+    # only at elections, so the table of the last K (start, term)
+    # transitions answers every term-of-position read the step needs in
+    # O(K) — replacing O(W) one-hot ring reads that profiled as ~70% of
+    # the TPU tick.  Positions older than the oldest retained transition
+    # fall back to the host catch-up path (same contract as falling out
+    # of the W ring).
+    term_table_slots: int = 8
+
     # Commit-advance kernel: "point" (etcd's maybeCommit shortcut — check
     # only the quorum index), "windowed" (full masked scan of the ring,
     # ops/commit_scan.py), or "pallas" (hand-written TPU kernel,
@@ -95,6 +105,15 @@ class RaftConfig:
     # instead of rewriting live data (storage/wal.py; etcd/wal's segment
     # dir as opened at reference raft.go:99-117).
     wal_segment_bytes: int = WAL_SEGMENT_BYTES_DEFAULT
+
+    # Maintain the [G, W] term ring on device.  With every hot-path term
+    # read served by the O(K) transition table, the ring is only needed
+    # by the windowed/pallas commit rules and by test oracles; the
+    # benchmark's fused "point" configuration drops it (the ring WRITE
+    # fills were ~40% of the remaining device tick at G=32k).  When
+    # False, log_term is kept as a [G, 1] stub so the state pytree keeps
+    # its shape.
+    keep_ring: bool = True
 
     seed: int = 0
 
@@ -111,6 +130,10 @@ class RaftConfig:
             raise ValueError("election_ticks must be > 2*heartbeat_ticks")
         if self.commit_rule not in ("point", "windowed", "pallas"):
             raise ValueError(f"unknown commit_rule {self.commit_rule!r}")
+        if not self.keep_ring and self.commit_rule != "point":
+            raise ValueError(
+                f"commit_rule {self.commit_rule!r} scans the term ring; "
+                "it requires keep_ring=True")
 
     @property
     def quorum(self) -> int:

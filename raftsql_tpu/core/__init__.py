@@ -1,6 +1,8 @@
 from raftsql_tpu.core.state import (Inbox, Outbox, PeerState, StepInfo,
-                                    empty_inbox, init_peer_state, term_at)
+                                    empty_inbox, init_peer_state, tbl_floor,
+                                    term_at, term_at_tbl)
 from raftsql_tpu.core.step import peer_step, peer_step_jit
 
 __all__ = ["Inbox", "Outbox", "PeerState", "StepInfo", "empty_inbox",
-           "init_peer_state", "term_at", "peer_step", "peer_step_jit"]
+           "init_peer_state", "tbl_floor", "term_at", "term_at_tbl",
+           "peer_step", "peer_step_jit"]

@@ -42,6 +42,19 @@ class PeerState(NamedTuple):
     log_len: jax.Array       # [G] i32 highest appended log index
     log_term: jax.Array      # [G, W] i32 ring: term of entry i at (i-1) % W
 
+    # Term-transition table: the step's authoritative source for
+    # term-of-position reads (the ring above stays write-only in the hot
+    # path, serving the windowed/pallas commit rules and test oracles).
+    # Slot k (valid iff tbl_pos[k] > 0) says: entries from position
+    # tbl_pos[k] up to the next transition carry term tbl_term[k].  Valid
+    # slots are right-aligned and ascending in position; slot K-1 always
+    # holds the newest transition of a non-empty log.  Terms are known
+    # for positions in [tbl_floor(tbl_pos, log_len), log_len]; reads
+    # below the floor are guarded exactly like reads that slid out of
+    # the W ring (reject + host catch-up).
+    tbl_pos: jax.Array       # [G, K] i32 transition start positions
+    tbl_term: jax.Array      # [G, K] i32 term starting at tbl_pos[k]
+
     # Timers (in ticks).
     elapsed: jax.Array       # [G] i32 ticks since last heartbeat/vote grant
     timeout: jax.Array       # [G] i32 randomized election timeout in ticks
@@ -145,7 +158,9 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
         leader_hint=jnp.full((g,), NO_LEADER, I32),
         commit=jnp.zeros((g,), I32),
         log_len=jnp.zeros((g,), I32),
-        log_term=jnp.zeros((g, w), I32),
+        log_term=jnp.zeros((g, w if cfg.keep_ring else 1), I32),
+        tbl_pos=jnp.zeros((g, cfg.term_table_slots), I32),
+        tbl_term=jnp.zeros((g, cfg.term_table_slots), I32),
         elapsed=jnp.zeros((g,), I32),
         timeout=timeout,
         hb_elapsed=jnp.zeros((g,), I32),
@@ -175,13 +190,20 @@ def restore_peer_state(cfg: RaftConfig, self_id: int,
     import numpy as np
 
     st = init_peer_state(cfg, self_id, seed)
-    g_, w = cfg.num_groups, cfg.log_window
+    g_, k_ = cfg.num_groups, cfg.term_table_slots
+    # Honor the keep_ring stub contract: the restored pytree must have
+    # the same leaf shapes as init_peer_state's, or the post-restart jit
+    # programs (and sharded buffers) would retrace against a wide ring
+    # the config promised not to carry.
+    w = cfg.log_window if cfg.keep_ring else 1
     starts = starts or {}
     term = np.zeros((g_,), np.int32)
     voted = np.full((g_,), NO_VOTE, np.int32)
     commit = np.zeros((g_,), np.int32)
     log_len = np.zeros((g_,), np.int32)
     window = np.zeros((g_, w), np.int32)
+    tbl_pos = np.zeros((g_, k_), np.int32)
+    tbl_term = np.zeros((g_, k_), np.int32)
     for g in range(g_):
         t, v, c = hard.get(g, (0, NO_VOTE, 0))
         term[g], voted[g], commit[g] = t, v, c
@@ -193,13 +215,30 @@ def restore_peer_state(cfg: RaftConfig, self_id: int,
             window[g, (idx - 1) % w] = terms[idx - 1 - start]
         if start >= 1 and start > log_len[g] - w:
             window[g, (start - 1) % w] = start_term
+        # Term-transition table over the same known span: the boundary
+        # (start, start_term) if still adjacent, then every term change
+        # in the replayed entries; keep the newest K, right-aligned.
+        trans = []
+        if start >= 1:
+            trans.append((start, start_term))
+        last = start_term if start >= 1 else 0
+        for idx in range(start + 1, log_len[g] + 1):
+            tt = terms[idx - 1 - start]
+            if tt != last:
+                trans.append((idx, tt))
+                last = tt
+        trans = trans[-k_:]
+        for j, (pos_, term_) in enumerate(trans):
+            tbl_pos[g, k_ - len(trans) + j] = pos_
+            tbl_term[g, k_ - len(trans) + j] = term_
         # The snapshot floor is committed by construction; hard.commit can
         # trail it only if the marker postdates the last hardstate record.
         commit[g] = min(max(commit[g], start), log_len[g])
     return st._replace(
         term=jnp.asarray(term), voted_for=jnp.asarray(voted),
         commit=jnp.asarray(commit), log_len=jnp.asarray(log_len),
-        log_term=jnp.asarray(window))
+        log_term=jnp.asarray(window),
+        tbl_pos=jnp.asarray(tbl_pos), tbl_term=jnp.asarray(tbl_term))
 
 
 import functools
@@ -227,8 +266,16 @@ def install_snapshot_state(state: PeerState, g: jax.Array,
     """
     g = jnp.asarray(g, I32)
     last_idx = jnp.asarray(last_idx, I32)
-    ring = jnp.zeros((window,), I32).at[(last_idx - 1) % window].set(
+    # The ring may be a [G, 1] stub (cfg.keep_ring=False): write modulo
+    # its actual width, which degenerates harmlessly.
+    rw = state.log_term.shape[-1]
+    ring = jnp.zeros((rw,), I32).at[(last_idx - 1) % rw].set(
         jnp.asarray(last_term, I32))
+    # Table analog of the cleared ring: one transition at the snapshot
+    # boundary — terms known exactly for [last_idx, last_idx].
+    K = state.tbl_pos.shape[-1]
+    tpos = jnp.zeros((K,), I32).at[K - 1].set(last_idx)
+    tterm = jnp.zeros((K,), I32).at[K - 1].set(jnp.asarray(last_term, I32))
     sender_term = jnp.asarray(sender_term, I32)
     newer = sender_term > state.term[g]
     return state._replace(
@@ -238,6 +285,8 @@ def install_snapshot_state(state: PeerState, g: jax.Array,
         log_len=state.log_len.at[g].set(last_idx),
         commit=state.commit.at[g].set(last_idx),
         log_term=state.log_term.at[g].set(ring),
+        tbl_pos=state.tbl_pos.at[g].set(tpos),
+        tbl_term=state.tbl_term.at[g].set(tterm),
         role=state.role.at[g].set(FOLLOWER),
         votes=state.votes.at[g].set(False),
         match=state.match.at[g].set(0),
@@ -272,6 +321,43 @@ def empty_inbox(cfg: RaftConfig) -> Inbox:
         a_ents=jnp.zeros((g, p, e), I32), a_commit=z,
         a_success=zb, a_match=z,
     )
+
+
+def term_at_tbl(tbl_pos: jax.Array, tbl_term: jax.Array, log_len: jax.Array,
+                idx: jax.Array) -> jax.Array:
+    """Term of entry `idx` from the transition table; term_at(0) == 0.
+
+    `idx` may be [...] or [..., X] against tables [..., K].  Because terms
+    are nondecreasing in position, the term at idx is the MAX term over
+    valid transitions starting at or before idx.  Out of range (idx < 1,
+    idx > log_len, or idx below the table floor) returns 0 — callers
+    guard floor reads exactly as they guard out-of-ring reads.
+
+    This is the O(K) read that replaced the O(W) ring read in the hot
+    step: the [G, P, E] batch-term read alone was 68% of the profiled
+    TPU tick at G=32k (see ops/dense.py for why gathers are not an
+    option on that backend).
+    """
+    idx = jnp.asarray(idx)
+    squeeze = idx.ndim == tbl_pos.ndim - 1
+    idx2 = idx[..., None] if squeeze else idx
+    hit = (tbl_pos[..., None, :] > 0) \
+        & (tbl_pos[..., None, :] <= idx2[..., None])    # [..., X, K]
+    got = jnp.max(jnp.where(hit, tbl_term[..., None, :], 0), axis=-1)
+    if squeeze:
+        got = got[..., 0]
+    else:
+        log_len = log_len[..., None]
+    valid = (idx >= 1) & (idx <= log_len)
+    return jnp.where(valid, got, 0)
+
+
+def tbl_floor(tbl_pos: jax.Array, log_len: jax.Array) -> jax.Array:
+    """Lowest position whose term the table still knows; log_len + 1 for
+    an empty table (every read is then out of range anyway)."""
+    valid = tbl_pos > 0
+    f = jnp.min(jnp.where(valid, tbl_pos, jnp.iinfo(I32).max), axis=-1)
+    return jnp.where(valid.any(-1), f, log_len + 1)
 
 
 def term_at(log_term: jax.Array, log_len: jax.Array, idx: jax.Array,

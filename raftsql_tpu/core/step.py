@@ -37,7 +37,7 @@ from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_NONE,
                                 MSG_PREREQ, MSG_PRERESP, MSG_REQ, MSG_RESP,
                                 NO_LEADER, NO_VOTE, PRECANDIDATE, RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
-                                    term_at)
+                                    tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
 from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
 
@@ -74,7 +74,17 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     self_onehot = src_ids == self_id                             # [1, P]
 
     log_term, log_len = state.log_term, state.log_len
+    tbl_pos, tbl_term = state.tbl_pos, state.tbl_term
     commit0 = state.commit
+    # Every term-of-position read below goes through the O(K) transition
+    # table (state.tbl_pos/tbl_term); the O(W) ring is write-only here
+    # (it feeds the windowed/pallas commit rules and test oracles).
+    # Positions below the table floor are unreadable and guarded like
+    # out-of-ring positions.
+    floor0 = tbl_floor(tbl_pos, log_len)                          # [G]
+
+    def term_of0(idx):  # reads against the PRE-append log
+        return term_at_tbl(tbl_pos, tbl_term, log_len, idx)
 
     # ---- Phase 1: term catch-up.  Any message with a newer term makes us a
     # follower of that term (raft §5.1) — EXCEPT prevote traffic carrying a
@@ -95,7 +105,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     votes = jnp.where(bumped[:, None], False, state.votes)
     leader_hint = jnp.where(bumped, NO_LEADER, state.leader_hint)
 
-    my_last_term = term_at(log_term, log_len, log_len, W)         # [G]
+    my_last_term = term_of0(log_len)                              # [G]
 
     # ---- Phase 2: RequestVote requests.  Grant at most one vote per group
     # per tick (voted_for is single-valued); re-granting to the same
@@ -181,16 +191,18 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     a_ents = pick(inbox.a_ents)                                   # [G, E]
     a_commit = pick(inbox.a_commit)
 
-    # Log-matching check — but ONLY for positions the ring can still
-    # verify: term_at() for prev <= log_len - W reads a slot now owned by
-    # a newer entry (ring aliasing), and a stale append (old leader, or
-    # one raced by an InstallSnapshot that cleared the ring) whose
-    # prev_term happens to equal the aliased slot would be falsely
-    # accepted — conflict-truncating a log it never matched.  Out-of-ring
-    # prev is rejected instead; the sender's walkback then lands on host
+    # Log-matching check — but ONLY for positions whose term is still
+    # known: below the table floor (or out of the W ring) the term is
+    # gone, and a stale append (old leader, or one raced by an
+    # InstallSnapshot that cleared the log metadata) must be rejected
+    # rather than trusted — accepting it would conflict-truncate a log
+    # it never matched.  The sender's walkback then lands on host
     # catch-up or a snapshot, which is the correct path for that gap.
-    prev_ok = (prev == 0) | ((prev <= log_len) & (prev > log_len - W)
-                             & (term_at(log_term, log_len, prev, W) == prev_t))
+    # prev == 0 is only exempt while the table still covers position 1,
+    # else the batch's own overlap terms would be unverifiable.
+    prev_ok = ((prev == 0) & (floor0 <= 1)) \
+        | ((prev <= log_len) & (prev > log_len - W) & (prev >= floor0)
+           & (term_of0(prev) == prev_t))
     accept = any_app & prev_ok & (role != LEADER)
 
     # Conflict detection at the ENDPOINT only: the batch and our log agree
@@ -201,7 +213,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # endpoint.  One [G] ring read replaces the [G, E]-wide per-position
     # scan (which profiled as 34% of the TPU tick, see ops/dense.py).
     ov_n = jnp.clip(jnp.minimum(prev + a_n, log_len) - prev, 0, E)  # [G]
-    ov_term = term_at(log_term, log_len, prev + ov_n, W)
+    ov_term = term_of0(prev + ov_n)
     batch_ov = dense.pick_batch(a_ents, jnp.maximum(ov_n - 1, 0))
     conflict = accept & (ov_n > 0) & (ov_term != batch_ov)
     # Ring write of the accepted batch, scatter-free (ops/dense.py): entry
@@ -209,17 +221,55 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # (w - prev) mod W when that is < n.  One-hot over E replaces the
     # serialized XLA scatter the TPU path cannot afford.
     a_n_w = jnp.clip(a_n, 0, E)
-    wpos = jnp.arange(W, dtype=I32)[None, :]                       # [1, W]
-    rel4 = (wpos - prev[:, None]) % W                              # [G, W]
-    hit4 = accept[:, None] & (rel4 < a_n_w[:, None])
-    vals4 = dense.ring_gather_values(a_ents, rel4, a_n_w)
-    log_term = jnp.where(hit4, vals4, log_term)
+    if cfg.keep_ring:
+        wpos = jnp.arange(W, dtype=I32)[None, :]                   # [1, W]
+        rel4 = (wpos - prev[:, None]) % W                          # [G, W]
+        hit4 = accept[:, None] & (rel4 < a_n_w[:, None])
+        vals4 = dense.ring_gather_values(a_ents, rel4, a_n_w)
+        log_term = jnp.where(hit4, vals4, log_term)
     app_end = prev + a_n
     follower_len0 = log_len
     log_len = jnp.where(
         accept,
         jnp.where(conflict, app_end, jnp.maximum(log_len, app_end)),
         log_len)
+
+    # Transition-table merge for the accepted batch.  Old transitions
+    # survive up to the first rewritten-and-changed position (everything
+    # on conflict-free overlap is unchanged by Log Matching); new
+    # transitions come from term changes inside the batch's genuinely
+    # new span.  Candidates stay position-ascending by construction
+    # (kept old <= boundary < added new), so compaction is a reversed
+    # prefix-count that right-aligns the newest K — no sort.
+    new_from = jnp.where(conflict, prev, follower_len0)           # [G]
+    old_keep = (tbl_pos > 0) & (
+        ~(accept & conflict)[:, None] | (tbl_pos <= prev[:, None]))
+    erange = jnp.arange(E, dtype=I32)[None, :]
+    pos_e = prev[:, None] + 1 + erange                            # [G, E]
+    prev_term_known = term_of0(prev)                              # [G]
+    ents_shift = jnp.concatenate(
+        [prev_term_known[:, None], a_ents[:, :-1]], axis=-1)      # [G, E]
+    bnd = a_ents != ents_shift
+    new_add = accept[:, None] & (erange < a_n_w[:, None]) \
+        & (pos_e > new_from[:, None]) & bnd                       # [G, E]
+    K = tbl_pos.shape[-1]
+    cand_pos = jnp.concatenate(
+        [jnp.where(old_keep, tbl_pos, 0), jnp.where(new_add, pos_e, 0)], -1)
+    cand_term = jnp.concatenate(
+        [jnp.where(old_keep, tbl_term, 0), jnp.where(new_add, a_ents, 0)],
+        -1)                                                       # [G, K+E]
+    cvalid = cand_pos > 0
+    # r[i] = number of valid candidates strictly after i; keep the newest
+    # K and right-align them at slot K-1-r.
+    r = jnp.cumsum(cvalid[:, ::-1], axis=-1)[:, ::-1] - cvalid
+    keep = cvalid & (r < K)
+    slot = jnp.where(keep, K - 1 - r, K)                          # K = drop
+    krange = jnp.arange(K, dtype=slot.dtype)
+    hit_k = slot[:, :, None] == krange                            # [G,K+E,K]
+    merged_pos = jnp.sum(jnp.where(hit_k, cand_pos[:, :, None], 0), axis=1)
+    merged_term = jnp.sum(jnp.where(hit_k, cand_term[:, :, None], 0), axis=1)
+    tbl_pos = jnp.where(accept[:, None], merged_pos, tbl_pos)
+    tbl_term = jnp.where(accept[:, None], merged_term, tbl_term)
     # Raft Fig. 2: commit = min(leaderCommit, index of last new entry).  The
     # clamp to app_end (not log_len) matters: positions beyond the accepted
     # batch are unverified and may diverge from the leader.
@@ -258,9 +308,27 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # write is a pure mask fill (no scatter, no value gather): slot w is
     # written iff (w - log_len) mod W < total_app, i.e. it holds one of
     # positions log_len+1 .. log_len+total_app.
-    rel6 = (wpos - log_len[:, None]) % W                           # [G, W]
-    log_term = jnp.where(rel6 < total_app[:, None], term[:, None], log_term)
+    if cfg.keep_ring:
+        rel6 = (wpos - log_len[:, None]) % W                       # [G, W]
+        log_term = jnp.where(rel6 < total_app[:, None], term[:, None],
+                             log_term)
+    # Table push: appends are all at the leader's current term, so at most
+    # one new transition — at the first appended position, iff the log's
+    # newest term differs.  Right-aligned layout makes this a static
+    # shift-left + write of slot K-1.
+    push = (total_app > 0) & (tbl_term[:, K - 1] != term)
+    shifted_pos = jnp.concatenate(
+        [tbl_pos[:, 1:], (log_len + 1)[:, None]], axis=-1)
+    shifted_term = jnp.concatenate(
+        [tbl_term[:, 1:], term[:, None]], axis=-1)
+    tbl_pos = jnp.where(push[:, None], shifted_pos, tbl_pos)
+    tbl_term = jnp.where(push[:, None], shifted_term, tbl_term)
     log_len = log_len + total_app
+
+    def term_of1(idx):  # reads against the POST-append log
+        return term_at_tbl(tbl_pos, tbl_term, log_len, idx)
+
+    floor1 = tbl_floor(tbl_pos, log_len)                          # [G]
     match = jnp.where(is_leader[:, None] & self_onehot, log_len[:, None],
                       match)
 
@@ -280,7 +348,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     else:
         commit = quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            quorum=quorum, window=W)
+            quorum=quorum, window=W, term_of=term_of1)
 
     # ---- Phase 8: timers and election start.
     reset = any_grant | any_app
@@ -323,7 +391,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # responses first, then candidate vote-request broadcast, then leader
     # append broadcast.  A later write overriding a response is safe: every
     # message carries the sender term, and raft re-sends on the next tick.
-    my_last_term2 = term_at(log_term, log_len, log_len, W)
+    my_last_term2 = term_of1(log_len)
 
     is_cand = role == CANDIDATE
     cand_bcast = is_cand[:, None] & ~self_onehot
@@ -369,27 +437,30 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         hb_fire[:, None] | (next_idx <= log_len[:, None]))
     prev_s = jnp.clip(next_idx - 1, 0, log_len[:, None])          # [G, P]
     n_s = jnp.clip(log_len[:, None] - prev_s, 0, E)
-    # Ring-window guard: every position this message reads (prev_s and the
-    # batch entries) must still be inside the W-entry term ring, or the
-    # gathered terms would be garbage from newer entries occupying the
-    # slots.  A follower lagging more than W entries instead gets an EMPTY
-    # heartbeat at prev=0 (always matches, carries no entries, and its
-    # commit clamp min(leaderCommit, app_end=0) moves nothing) — this keeps
-    # its election timer reset so it cannot depose the live leader by
-    # starting elections, while actual catch-up is host-mediated
-    # (runtime roadmap).  It cannot win elections meanwhile (log
-    # up-to-dateness check), so safety holds while it lags.
+    # Term-window guard: every position this message reads (prev_s and
+    # the batch entries) must still have a KNOWN term — inside the W
+    # ring AND at or above the transition-table floor.  A follower
+    # lagging past either limit instead gets an EMPTY heartbeat at
+    # prev=0, which resets its election timer either way: a receiver
+    # whose own table floor is <= 1 accepts it (matches, carries no
+    # entries, commit clamp min(leaderCommit, app_end=0) moves
+    # nothing), while one whose floor rose past 1 (post-install, or >K
+    # transitions) REJECTS it — harmless churn, since the timer reset
+    # rides any_app, not accept.  Either way the laggard cannot depose
+    # the live leader by starting elections, cannot win one meanwhile
+    # (log up-to-dateness check), and actual catch-up is host-mediated
+    # (runtime/node.py) — so safety holds while it lags.
     win_floor = log_len[:, None] - W                              # [G, 1]
     min_acc = jnp.where(prev_s > 0, prev_s,
                         jnp.where(n_s > 0, 1, 0))
-    in_window = (min_acc == 0) | (min_acc > win_floor)
+    in_window = (min_acc == 0) | ((min_acc > win_floor)
+                                  & (min_acc >= floor1[:, None]))
     prev_s = jnp.where(in_window, prev_s, 0)
     n_s = jnp.where(in_window, n_s, 0)
-    prev_t_s = term_at(log_term, log_len, prev_s, W)
+    prev_t_s = term_of1(prev_s)                                   # [G, P]
     ent_pos_s = prev_s[:, :, None] + 1 \
         + jnp.arange(E, dtype=I32)[None, None, :]                 # [G, P, E]
-    ents_s = term_at(log_term, log_len,
-                     ent_pos_s.reshape(G, P * E), W).reshape(G, P, E)
+    ents_s = term_of1(ent_pos_s.reshape(G, P * E)).reshape(G, P, E)
 
     # Pipelined replication (etcd's optimistic sendAppend): advance
     # next_idx past the entries just sent instead of idling an ack round
@@ -438,6 +509,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     new_state = PeerState(
         term=term, voted_for=voted, role=role, leader_hint=leader_hint,
         commit=commit, log_len=log_len, log_term=log_term,
+        tbl_pos=tbl_pos, tbl_term=tbl_term,
         elapsed=elapsed, timeout=timeout, hb_elapsed=hb,
         votes=votes, match=match, next_idx=next_idx,
         rng=state.rng, tick=state.tick + 1)

@@ -30,14 +30,23 @@ def quorum_match_index(match: jax.Array, quorum: int) -> jax.Array:
 def quorum_commit_index(match: jax.Array, log_term: jax.Array,
                         log_len: jax.Array, commit: jax.Array,
                         term: jax.Array, is_leader: jax.Array,
-                        *, quorum: int, window: int) -> jax.Array:
-    """Advance per-group commit indexes for leader rows; monotone for all."""
+                        *, quorum: int, window: int,
+                        term_of=None) -> jax.Array:
+    """Advance per-group commit indexes for leader rows; monotone for all.
+
+    `term_of(idx)` overrides the term read (the hot step passes the O(K)
+    transition-table reader, core/state.py term_at_tbl); the default
+    reads the ring for standalone callers and tests.
+    """
     # Deferred import: core.step imports this module, so a module-level
     # import of core.state would be circular when ops loads first.
     from raftsql_tpu.core.state import term_at
 
     cand = quorum_match_index(match, quorum)
-    cand_term = term_at(log_term, log_len, cand, window)
+    if term_of is None:
+        cand_term = term_at(log_term, log_len, cand, window)
+    else:
+        cand_term = term_of(cand)
     ok = is_leader & (cand_term == term) & (cand > commit)
     return jnp.where(ok, cand, commit)
 

@@ -71,6 +71,7 @@ def state_specs() -> PeerState:
     return PeerState(
         term=s2, voted_for=s2, role=s2, leader_hint=s2,
         commit=s2, log_len=s2, log_term=s3,
+        tbl_pos=s3, tbl_term=s3,
         elapsed=s2, timeout=s2, hb_elapsed=s2,
         votes=s3, match=s3, next_idx=s3,
         rng=P(PEERS_AXIS), tick=P(PEERS_AXIS))

@@ -311,3 +311,38 @@ class TestCommitSafety:
             states, inboxes, _ = cluster_step(cfg, states, inboxes, props)
             assert (np.asarray(states.commit)
                     <= np.asarray(states.log_len)).all()
+
+
+class TestRinglessConfig:
+    def test_ringless_matches_ringed_trajectory(self):
+        """keep_ring=False (the benchmark's point-rule configuration) must
+        be a pure representation change: identical consensus evolution,
+        with log_term a [G, 1] stub."""
+        import functools
+
+        import jax
+
+        from raftsql_tpu.core.cluster import (cluster_step,
+                                              empty_cluster_inbox,
+                                              init_cluster_state)
+
+        def run(keep_ring):
+            cfg = small_cfg(seed=21, keep_ring=keep_ring)
+            step = jax.jit(functools.partial(cluster_step, cfg))
+            st = init_cluster_state(cfg)
+            ib = empty_cluster_inbox(cfg)
+            rng = np.random.default_rng(3)
+            for _ in range(80):
+                props = jnp.asarray(
+                    (rng.random((cfg.num_peers, cfg.num_groups)) < 0.5)
+                    .astype(np.int32))
+                st, ib, _ = step(st, ib, props)
+            return st
+
+        a, b = run(True), run(False)
+        assert b.log_term.shape[-1] == 1
+        for f in ("term", "role", "commit", "log_len", "tbl_pos",
+                  "tbl_term", "match", "next_idx", "voted_for"):
+            assert (np.asarray(getattr(a, f))
+                    == np.asarray(getattr(b, f))).all(), f
+        assert (np.asarray(a.commit) > 0).any()

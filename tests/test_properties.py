@@ -54,6 +54,7 @@ class InvariantChecker:
         commit = np.asarray(states.commit)
         log_len = np.asarray(states.log_len)
         terms = window_terms(states, cfg)    # [P, G, L]
+        self.check_table_matches_ring(states, t)
 
         for g in range(cfg.num_groups):
             # Election safety.
@@ -81,6 +82,35 @@ class InvariantChecker:
                     f"{hist[floor:overlap]} vs {pterms[floor:overlap]}")
                 if c > len(hist) and len(hist) >= floor:
                     self.committed[g] = hist + pterms[len(hist):c]
+
+    def check_table_matches_ring(self, states, t):
+        """The O(K) term-transition table (the step's read path) must agree
+        with the O(W) ring (its write path) on every position BOTH can
+        still observe: above the table floor and inside the ring window."""
+        from raftsql_tpu.core.state import tbl_floor, term_at_tbl
+
+        cfg = self.cfg
+        L = int(np.asarray(states.log_len).max())
+        if L == 0:
+            return
+        idx = jnp.arange(1, L + 1, dtype=jnp.int32)[None, :]
+        idxb = jnp.broadcast_to(idx, (cfg.num_groups, L))
+        log_len = np.asarray(states.log_len)
+        floor = np.asarray(tbl_floor(states.tbl_pos, states.log_len))
+        for p in range(cfg.num_peers):
+            ring = np.asarray(term_at(states.log_term[p], states.log_len[p],
+                                      idxb, cfg.log_window))
+            tbl = np.asarray(term_at_tbl(states.tbl_pos[p],
+                                         states.tbl_term[p],
+                                         states.log_len[p], idxb))
+            for g in range(cfg.num_groups):
+                lo = max(int(floor[p, g]),
+                         int(log_len[p, g]) - cfg.log_window + 1, 1)
+                hi = int(log_len[p, g])
+                a, b = tbl[g, lo - 1:hi], ring[g, lo - 1:hi]
+                assert (a == b).all(), (
+                    f"t={t} g={g} p={p}: table/ring term divergence in "
+                    f"[{lo},{hi}]: {a.tolist()} vs {b.tolist()}")
 
 
 def run_chaos(cfg, ticks, p_drop=0.0, partition_schedule=(), prop_rate=0.3,
