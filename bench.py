@@ -224,13 +224,26 @@ def bench_latency_sweep(groups: int, peers: int, repeats: int) -> dict:
     engine floor.  Reports {load_label: {p50_ms, p99_ms, tick_ms}}.
     """
     sweep = {}
-    ticks = 32          # latency crossings happen in the first few ticks
+    # Long scans so tick_ms reflects the DEVICE tick cadence (the
+    # tunnel's ~70 ms per-execution dispatch would otherwise inflate a
+    # 32-tick call's apparent tick time ~5x); the commit crossing still
+    # lands in the first few ticks and p50 = crossing_ticks x tick_ms.
+    ticks = 256
+    # Latency is a best-case target (<2 ms p50, BASELINE.md): measure at
+    # a modest group count where the tick is fastest, and again at the
+    # headline shape so the queueing story at scale is also on record.
+    lat_groups = min(groups, int(os.environ.get("BENCH_LAT_GROUPS", "1024")))
     E = int(os.environ.get("BENCH_E", "16"))
-    for label, load in (("light_1", 1), (f"half_{E // 2}", E // 2),
-                        (f"sat_{E}", None)):
-        _log(f"== latency @ {label} (G={groups}) ==")
+    for label, load in ((f"light_1_G{lat_groups}", 1),
+                        (f"sat_{E}_G{lat_groups}", None),
+                        (f"sat_{E}_G{groups}", "headline")):
+        g = groups if load == "headline" else lat_groups
+        ld = None if load in (None, "headline") else load
+        if load == "headline" and groups == lat_groups:
+            continue        # same shape as the sat_G{lat_groups} row
+        _log(f"== latency @ {label} ==")
         st: dict = {}
-        bench_throughput(groups, peers, ticks, repeats, load=load, stats=st)
+        bench_throughput(g, peers, ticks, repeats, load=ld, stats=st)
         sweep[label] = st
     return sweep
 
@@ -481,6 +494,15 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
             m.t_stage_ms = m.t_device_ms = m.t_wal_ms = 0.0
             m.t_send_ms = m.t_publish_ms = 0.0
         best = 0.0
+        # BENCH_DURABLE_ACTIVE=N: queue load at only the first N groups.
+        # The durable tick's Python cost is proportional to ACTIVE groups
+        # (vectorized masks give idle groups ~zero work, runtime/node.py
+        # _wal_phase/_publish_phase); this knob separates "how many groups
+        # can the host carry" (G) from "how many proposals/tick can it
+        # push" (active * E) — at G=10k the saturated-everywhere point
+        # measures Python object handling, not the runtime's scaling.
+        active = int(os.environ.get("BENCH_DURABLE_ACTIVE", "0")) or groups
+        active = min(active, groups)
         for _ in range(repeats):
             # Pre-queue ticks*E proposals per group at its leader.
             # kv keeps the original unique-key workload (comparable to
@@ -489,7 +511,7 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
                 cmds = [mk_cmd.encode()] * (ticks * E)
             else:
                 cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
-            for g in range(groups):
+            for g in range(active):
                 h = int(hints[g])
                 nodes[h if h >= 0 else 0].propose_many(g, cmds)
             drain(nodes[0], apply=False)        # discard warmup commits
@@ -601,7 +623,9 @@ def run_config(config: str, cpu: bool):
     if os.environ.get("BENCH_SKIP_SWEEP") != "1":
         sweep = bench_latency_sweep(groups, peers, max(1, repeats - 1))
         extras["lat"] = sweep
-        extras["p50_light_ms"] = sweep.get("light_1", {}).get("p50_ms")
+        light = next((v for k, v in sweep.items()
+                      if k.startswith("light_1")), {})
+        extras["p50_light_ms"] = light.get("p50_ms")
     return value, extras
 
 

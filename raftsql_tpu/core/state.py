@@ -132,9 +132,14 @@ class StepInfo(NamedTuple):
     new_log_len: jax.Array   # i32 log length after the step
     # Leader view [G, P]: where each peer's replication stands.  The host
     # uses this to spot followers that have fallen out of the device term
-    # ring (next_idx <= log_len - W) and feed them catch-up appends built
-    # from the host payload log (runtime/node.py).
+    # ring (next_idx <= log_len - W) OR below the transition-table floor
+    # and feed them catch-up appends built from the host payload log
+    # (runtime/node.py).
     next_idx: jax.Array      # i32 [G, P] next index to send each peer
+    # Lowest position whose term the transition table still knows
+    # (core/step.py floor1): the device suppresses real appends to
+    # followers below it, so the host must serve them.
+    floor: jax.Array         # i32 [G]
 
 
 def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
@@ -244,11 +249,10 @@ def restore_peer_state(cfg: RaftConfig, self_id: int,
 import functools
 
 
-@functools.partial(jax.jit, donate_argnums=0, static_argnums=4)
+@functools.partial(jax.jit, donate_argnums=0)
 def install_snapshot_state(state: PeerState, g: jax.Array,
                            last_idx: jax.Array, last_term: jax.Array,
-                           window: int, sender_term: jax.Array
-                           ) -> PeerState:
+                           sender_term: jax.Array) -> PeerState:
     """Reset group `g`'s device row to a snapshot boundary.
 
     The follower installed a state-machine image at log position

@@ -191,40 +191,56 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     a_ents = pick(inbox.a_ents)                                   # [G, E]
     a_commit = pick(inbox.a_commit)
 
-    # Log-matching check — but ONLY for positions whose term is still
-    # known: below the table floor (or out of the W ring) the term is
-    # gone, and a stale append (old leader, or one raced by an
-    # InstallSnapshot that cleared the log metadata) must be rejected
-    # rather than trusted — accepting it would conflict-truncate a log
-    # it never matched.  The sender's walkback then lands on host
-    # catch-up or a snapshot, which is the correct path for that gap.
-    # prev == 0 is only exempt while the table still covers position 1,
-    # else the batch's own overlap terms would be unverifiable.
-    prev_ok = ((prev == 0) & (floor0 <= 1)) \
-        | ((prev <= log_len) & (prev > log_len - W) & (prev >= floor0)
-           & (term_of0(prev) == prev_t))
-    accept = any_app & prev_ok & (role != LEADER)
-
-    # Conflict detection at the ENDPOINT only: the batch and our log agree
-    # at prev (prev_ok), and by the Log Matching property two raft logs
-    # that share (index, term) at any position are identical up through
-    # it — so if the LAST overlapping position carries matching terms, so
-    # does every earlier one, and a mismatch anywhere implies one at the
-    # endpoint.  One [G] ring read replaces the [G, E]-wide per-position
-    # scan (which profiled as 34% of the TPU tick, see ops/dense.py).
+    # Log-matching check — but ONLY against positions whose term is
+    # still known: below the table floor the term is gone, and a stale
+    # append (old leader, or one raced by an InstallSnapshot that
+    # cleared the log metadata) must be rejected rather than trusted —
+    # accepting it would conflict-truncate a log it never matched.
+    # Two ways to verify a batch:
+    #   1. directly at prev (prev above the floor, terms match); or
+    #   2. at the batch's LAST overlapping position, when that is above
+    #      the floor and terms match there — by the Log Matching
+    #      property a shared (index, term) implies the whole prefix
+    #      (prev included) matches.  This unsticks a live deadlock: a
+    #      restarted follower whose own floor sits above the leader's
+    #      serving point would otherwise reject every catch-up append
+    #      (its reject hints can only walk next_idx DOWN), while the
+    #      anchor check lets it accept the overlap it already holds and
+    #      ack match=app_end.
+    # prev == 0 is only exempt while the table still covers position 1.
     ov_n = jnp.clip(jnp.minimum(prev + a_n, log_len) - prev, 0, E)  # [G]
     ov_term = term_of0(prev + ov_n)
     batch_ov = dense.pick_batch(a_ents, jnp.maximum(ov_n - 1, 0))
+    anchor_ok = (ov_n > 0) & (prev + ov_n >= floor0) \
+        & (ov_term == batch_ov)
+    prev_ok = ((prev == 0) & (floor0 <= 1)) \
+        | ((prev <= log_len) & (prev >= floor0)
+           & (term_of0(prev) == prev_t)) \
+        | ((prev <= log_len) & anchor_ok)
+    accept = any_app & prev_ok & (role != LEADER)
+
+    # Conflict detection reuses the endpoint read from above: by Log
+    # Matching, a term mismatch anywhere in the overlap implies one at
+    # the LAST overlapping position — one [G] table read replaces a
+    # [G, E]-wide per-position scan (which profiled as 34% of the TPU
+    # tick, see ops/dense.py).
     conflict = accept & (ov_n > 0) & (ov_term != batch_ov)
     # Ring write of the accepted batch, scatter-free (ops/dense.py): entry
     # e lands at slot (prev+e) % W, so slot w holds batch element
     # (w - prev) mod W when that is < n.  One-hot over E replaces the
-    # serialized XLA scatter the TPU path cannot afford.
+    # serialized XLA scatter the TPU path cannot afford.  Positions at or
+    # below (new log_len) - W are masked out: an anchor-verified batch
+    # may sit arbitrarily deep, and its slots would alias LIVE ring
+    # entries of newer positions.
     a_n_w = jnp.clip(a_n, 0, E)
     if cfg.keep_ring:
         wpos = jnp.arange(W, dtype=I32)[None, :]                   # [1, W]
         rel4 = (wpos - prev[:, None]) % W                          # [G, W]
-        hit4 = accept[:, None] & (rel4 < a_n_w[:, None])
+        len_after = jnp.where(conflict, prev + a_n,
+                              jnp.maximum(log_len, prev + a_n))    # [G]
+        pos4 = prev[:, None] + 1 + rel4
+        hit4 = accept[:, None] & (rel4 < a_n_w[:, None]) \
+            & (pos4 > len_after[:, None] - W)
         vals4 = dense.ring_gather_values(a_ents, rel4, a_n_w)
         log_term = jnp.where(hit4, vals4, log_term)
     app_end = prev + a_n
@@ -285,11 +301,19 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     match = jnp.where(rs_ok, jnp.maximum(match, inbox.a_match), match)
     next_idx = jnp.where(rs_ok, jnp.maximum(next_idx, inbox.a_match + 1),
                          next_idx)
-    # On reject, back off to the follower's conflict hint (its log length),
-    # the fast-backoff analog of etcd's rejection hints.
+    # On reject, back off to the follower's conflict hint (its log
+    # length), the fast-backoff analog of etcd's rejection hints — but a
+    # hint AT OR BEYOND our send point is a floor-reject resync request
+    # (Phase 4's floor_rej): the follower holds a log that long and can
+    # only verify appends near its tip, so JUMP next_idx up to hint + 1.
+    # A stale/bogus large hint self-corrects: the probe append at the
+    # jumped prev is itself verified (or floor-rejected with an honest
+    # hint) by the follower.
+    walked = jnp.clip(jnp.minimum(next_idx - 1, inbox.a_match + 1), 1,
+                      None)
     next_idx = jnp.where(
         rs_fail,
-        jnp.clip(jnp.minimum(next_idx - 1, inbox.a_match + 1), 1, None),
+        jnp.where(inbox.a_match >= next_idx, inbox.a_match + 1, walked),
         next_idx)
     next_idx = jnp.maximum(next_idx, match + 1)
 
@@ -426,10 +450,20 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # so old leaders step down).
     chosen_mask = areq_cur & (src_ids == asrc[:, None]) & any_app[:, None]
     succ = chosen_mask & accept[:, None]
-    # Conflict hint on reject: our pre-append log length.
+    # Conflict hint on reject: our pre-append log length — EXCEPT when
+    # the reject was a FLOOR reject (prev below what our transition
+    # table can verify): then the useful serving point is our full log
+    # length, whose prev we can always verify (floor <= newest
+    # transition <= log_len), and a hint at-or-beyond the leader's send
+    # point tells it to resync UP (Phase 5) instead of walking down —
+    # without this, a leader serving below a restarted follower's floor
+    # walks next_idx to 1 and the pair livelocks on rejects.
+    floor_rej = chosen_mask & ~accept[:, None] & (prev < floor0)[:, None]
     hint = jnp.clip(jnp.minimum(prev - 1, follower_len0), 0, None)
     resp_match = jnp.where(succ, app_end[:, None],
-                           jnp.where(chosen_mask, hint[:, None], 0))
+                           jnp.where(floor_rej, follower_len0[:, None],
+                                     jnp.where(chosen_mask, hint[:, None],
+                                               0)))
 
     # Leader append broadcast: to every peer with pending entries, plus
     # everyone on heartbeat.
@@ -523,7 +557,8 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         app_n=jnp.where(accept, a_n, 0),
         app_conflict=conflict,
         new_log_len=log_len,
-        next_idx=next_idx)
+        next_idx=next_idx,
+        floor=floor1)
 
     return new_state, outbox, info
 

@@ -92,7 +92,8 @@ def info_specs() -> StepInfo:
         commit=s2, role=s2, term=s2, voted_for=s2, leader_hint=s2,
         prop_base=s2, prop_accepted=s2, noop=s2,
         app_from=s2, app_start=s2, app_n=s2, app_conflict=s2,
-        new_log_len=s2, next_idx=P(PEERS_AXIS, GROUPS_AXIS, None))
+        new_log_len=s2, next_idx=P(PEERS_AXIS, GROUPS_AXIS, None),
+        floor=s2)
 
 
 def shard_cluster_arrays(mesh: Mesh, states: PeerState, inboxes: Inbox,

@@ -48,8 +48,9 @@ from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
                                           wrap_snapshot)
 from raftsql_tpu.storage.log import PayloadLog
 from raftsql_tpu.storage.wal import WAL, wal_exists
-from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
-                                        TickBatch, Transport, VoteRec)
+from raftsql_tpu.transport.base import (AppendRec, ColRecs, ProposalRec,
+                                        SnapshotRec, TickBatch, Transport,
+                                        VoteRec)
 from raftsql_tpu.utils.metrics import NodeMetrics
 
 log = logging.getLogger("raftsql_tpu.node")
@@ -86,6 +87,13 @@ class RaftNode:
         self._stage_votes: Dict[Tuple[int, int], VoteRec] = {}
         self._stage_apps: Dict[Tuple[int, int], AppendRec] = {}
         self._stage_snaps: Dict[int, SnapshotRec] = {}
+        # Columnar staging (transport/base.py ColRecs): payload-free
+        # messages scatter straight into these [G, P] arrays; record
+        # staging (payload appends, and peers speaking the record form)
+        # overlays them at inbox-build time.  _stg_a_seq carries the
+        # ReadIndex round binding for BOTH forms.
+        self._stg: Dict[str, np.ndarray] = self._fresh_stage_cols()
+        self._stg_a_seq = np.zeros((G, num_nodes), np.int64)
 
         # InstallSnapshot hooks (wired by the apply layer in resume mode;
         # both unset => full state transfer disabled, catch-up below the
@@ -150,6 +158,7 @@ class RaftNode:
         self._stopped = False           # full teardown ran (stop())
         self._thread: Optional[threading.Thread] = None
         self._tick_apps: Dict[Tuple[int, int], AppendRec] = {}
+        self._tick_seq = np.zeros((G, num_nodes), np.int64)
         # Serializes the tick's WAL phase against compaction rewrites.
         self._wal_lock = threading.Lock()
 
@@ -366,6 +375,51 @@ class RaftNode:
     # ------------------------------------------------------------------
     # transport plane
 
+    _STAGE_FIELDS = ("v_type", "v_term", "v_last_idx", "v_last_term",
+                     "v_granted", "a_type", "a_term", "a_prev_idx",
+                     "a_prev_term", "a_commit", "a_success", "a_match")
+
+    def _fresh_stage_cols(self) -> Dict[str, np.ndarray]:
+        G, P = self.cfg.num_groups, self.num_nodes
+        return {f: np.zeros((G, P), np.int32) for f in self._STAGE_FIELDS}
+
+    def _stage_cols(self, src0: int, c) -> None:
+        """Scatter one ColRecs into the staging arrays (stage-lock held).
+
+        Row validation is one vectorized mask (bad groups dropped, same
+        contract as the record path)."""
+        G = self.cfg.num_groups
+        if c.n_votes():
+            m = (c.v_group >= 0) & (c.v_group < G)
+            g = c.v_group[m]
+            s = self._stg
+            s["v_type"][g, src0] = c.v_type[m]
+            s["v_term"][g, src0] = c.v_term[m]
+            s["v_last_idx"][g, src0] = c.v_last_idx[m]
+            s["v_last_term"][g, src0] = c.v_last_term[m]
+            s["v_granted"][g, src0] = c.v_granted[m]
+        if c.n_appends():
+            m = (c.a_group >= 0) & (c.a_group < G)
+            g = c.a_group[m]
+            s = self._stg
+            s["a_type"][g, src0] = c.a_type[m]
+            s["a_term"][g, src0] = c.a_term[m]
+            s["a_prev_idx"][g, src0] = c.a_prev_idx[m]
+            s["a_prev_term"][g, src0] = c.a_prev_term[m]
+            s["a_commit"][g, src0] = c.a_commit[m]
+            s["a_success"][g, src0] = c.a_success[m]
+            s["a_match"][g, src0] = c.a_match[m]
+            seq = c.a_seq[m]
+            self._stg_a_seq[g, src0] = seq
+            # ReadIndex round bookkeeping for columnar responses.
+            rm = (c.a_type[m] == MSG_RESP) & (seq > 0)
+            if rm.any():
+                rg = g[rm]
+                newer = seq[rm] > self._resp_echo[rg, src0]
+                rg2 = rg[newer]
+                self._resp_echo[rg2, src0] = seq[rm][newer]
+                self._resp_term[rg2, src0] = c.a_term[m][rm][newer]
+
     def _deliver(self, src: int, batch: TickBatch) -> None:
         """Stage inbound records; newest message per (group, src, slot)
         wins, mirroring the dense Inbox overwrite semantics.
@@ -381,6 +435,8 @@ class RaftNode:
                         self.node_id, src)
             return
         with self._stage_lock:
+            if batch.cols is not None:
+                self._stage_cols(src0, batch.cols)
             for v in batch.votes:
                 if 0 <= v.group < G:
                     self._stage_votes[(v.group, src0)] = v
@@ -388,6 +444,7 @@ class RaftNode:
                 if 0 <= a.group < G and a.n <= E \
                         and len(a.payloads) in (0, a.n):
                     self._stage_apps[(a.group, src0)] = a
+                    self._stg_a_seq[a.group, src0] = a.seq
                     if a.type == MSG_RESP and a.seq:
                         # ReadIndex round bookkeeping: newest request-seq
                         # this peer has answered, and at what term.
@@ -544,8 +601,7 @@ class RaftNode:
                 self.wal.set_snapshot(g, rec.last_idx, rec.last_term)
                 self.wal.sync()
                 self.state = install_snapshot_state(
-                    self.state, g, rec.last_idx, rec.last_term,
-                    self.cfg.log_window, rec.term)
+                    self.state, g, rec.last_idx, rec.last_term, rec.term)
                 self._applied[g] = rec.last_idx
             if self._local[g]:
                 # Our uncommitted leader-era proposals may or may not be
@@ -562,17 +618,25 @@ class RaftNode:
     def _build_inbox(self):
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
-        z = lambda: np.zeros((G, P), np.int32)
-        zb = lambda: np.zeros((G, P), bool)
-        v_type, v_term, v_li, v_lt = z(), z(), z(), z()
-        v_gr = zb()
-        a_type, a_term, a_pi, a_pt, a_n, a_cm, a_ma = (
-            z(), z(), z(), z(), z(), z(), z())
-        a_su = zb()
+        a_n = np.zeros((G, P), np.int32)
         a_ents = np.zeros((G, P, E), np.int32)
         with self._stage_lock:
             votes, apps = self._stage_votes, self._stage_apps
             self._stage_votes, self._stage_apps = {}, {}
+            # Columnar staging becomes the inbox base (no copy — fresh
+            # arrays replace them for the next window); the record dicts
+            # overlay it below.  Columnar appends are always n == 0.
+            stg = self._stg
+            seq_arr = self._stg_a_seq
+            self._stg = self._fresh_stage_cols()
+            self._stg_a_seq = np.zeros_like(seq_arr)
+        v_type, v_term = stg["v_type"], stg["v_term"]
+        v_li, v_lt, v_gr = stg["v_last_idx"], stg["v_last_term"], \
+            stg["v_granted"].astype(bool)
+        a_type, a_term = stg["a_type"], stg["a_term"]
+        a_pi, a_pt = stg["a_prev_idx"], stg["a_prev_term"]
+        a_cm, a_ma = stg["a_commit"], stg["a_match"]
+        a_su = stg["a_success"].astype(bool)
         for (g, s), v in votes.items():
             v_type[g, s], v_term[g, s] = v.type, v.term
             v_li[g, s], v_lt[g, s] = v.last_idx, v.last_term
@@ -592,6 +656,7 @@ class RaftNode:
             a_n=jnp.asarray(a_n), a_ents=jnp.asarray(a_ents),
             a_commit=jnp.asarray(a_cm), a_success=jnp.asarray(a_su),
             a_match=jnp.asarray(a_ma))
+        self._tick_seq = seq_arr
         return inbox, apps
 
     def _wal_phase(self, info) -> None:
@@ -708,9 +773,22 @@ class RaftNode:
         term = np.asarray(info.term)
         # Margin of 2E: start host catch-up slightly before the hard edge
         # of the ring so a race with concurrent appends cannot strand the
-        # follower on garbage ring reads.
+        # follower on garbage ring reads.  The transition-table floor is
+        # a second, independent send-suppression edge (core/step.py
+        # in_window requires min_acc >= floor): more than K term
+        # transitions in the window raise it above the ring edge, and a
+        # follower below it would otherwise only ever see empty
+        # heartbeats.  Its lag test is the exact complement of the
+        # device guard (min_acc = max(next_idx-1, 1) for a non-empty
+        # send), needs no race margin — info.floor IS the floor this
+        # tick's sends were gated on — and is gated on the follower
+        # actually having entries to fetch, which keeps healthy
+        # followers out of the scan.
+        floor = np.asarray(info.floor)                  # [G]
         lag = (role == LEADER)[:, None] & (next_idx >= 1) \
-            & (next_idx - 1 <= log_len[:, None] - W + 2 * E)
+            & ((next_idx - 1 <= log_len[:, None] - W + 2 * E)
+               | ((next_idx <= log_len[:, None])
+                  & (np.maximum(next_idx - 1, 1) < floor[:, None])))
         lag[:, self.self_id] = False
         # Prune pacing state for peers that caught back up (its purpose
         # is served) and stale snapshot cooldowns (any in-flight transfer
@@ -763,38 +841,75 @@ class RaftNode:
 
         catchups = self._build_catchups(info)
 
-        # Columnar field extraction: one fancy-index gather per field plus
-        # a single .tolist() each, then a plain zip — per-element
-        # np-scalar indexing (the round-1/2 shape) costs ~10x more per
-        # message and dominated the tick at G >= 10k.
+        # Columnar emission (transport/base.py ColRecs): votes and
+        # payload-free appends (heartbeats + all responses) ship as
+        # fancy-indexed numpy column arrays — zero per-message Python.
+        # Only payload-carrying appends (count ∝ real replication
+        # traffic) and catch-up substitutions take the record path.
         vg, vd = np.nonzero(outbox.v_type)
         if vg.size:
-            for g, d, t, tm, li, lt, gr in zip(
-                    vg.tolist(), vd.tolist(),
-                    outbox.v_type[vg, vd].tolist(),
-                    outbox.v_term[vg, vd].tolist(),
-                    outbox.v_last_idx[vg, vd].tolist(),
-                    outbox.v_last_term[vg, vd].tolist(),
-                    outbox.v_granted[vg, vd].tolist()):
-                batch_for(d).votes.append(VoteRec(
-                    group=g, type=t, term=tm, last_idx=li, last_term=lt,
-                    granted=gr))
+            v_cols = {f: np.ascontiguousarray(
+                getattr(outbox, "v_" + f)[vg, vd], dtype=np.int32)
+                for f in ("type", "term", "last_idx", "last_term",
+                          "granted")}
+            for d in np.unique(vd).tolist():
+                rows = vd == d
+                b = batch_for(d)
+                if b.cols is None:
+                    b.cols = ColRecs()
+                b.cols.v_group = np.ascontiguousarray(vg[rows],
+                                                      dtype=np.int32)
+                for f, col in v_cols.items():
+                    setattr(b.cols, "v_" + f, col[rows])
+
         ag, ad = np.nonzero(outbox.a_type)
         emitted = set()
         if ag.size:
-            a_ents_rows = outbox.a_ents[ag, ad]          # [N, E]
-            for i, (g, d, mtype, tm, prev, pt, n, cm, su, ma) in enumerate(
-                    zip(ag.tolist(), ad.tolist(),
-                        outbox.a_type[ag, ad].tolist(),
-                        outbox.a_term[ag, ad].tolist(),
-                        outbox.a_prev_idx[ag, ad].tolist(),
-                        outbox.a_prev_term[ag, ad].tolist(),
-                        outbox.a_n[ag, ad].tolist(),
-                        outbox.a_commit[ag, ad].tolist(),
-                        outbox.a_success[ag, ad].tolist(),
-                        outbox.a_match[ag, ad].tolist())):
-                emitted.add((g, d))
-                cu = catchups.pop((g, d), None) if mtype == MSG_REQ else None
+            a_type_r = np.asarray(outbox.a_type[ag, ad])
+            a_n_r = np.asarray(outbox.a_n[ag, ad])
+            # Record path: REQs that carry entries, or whose slot has a
+            # pending host catch-up to substitute.
+            is_req = a_type_r == MSG_REQ
+            rec_rows = is_req & (a_n_r > 0)
+            if catchups:
+                cu_mask = np.zeros((cfg.num_groups, self.num_nodes), bool)
+                for (g, d) in catchups:
+                    cu_mask[g, d] = True
+                rec_rows |= is_req & cu_mask[ag, ad]
+            col_rows = ~rec_rows
+            if col_rows.any():
+                # seq: REQs carry this tick's number; responses echo the
+                # seq of the staged request they answer (ReadIndex round
+                # binding, same contract as the record path).
+                seq_all = np.where(is_req, np.int64(self._tick_no),
+                                   self._tick_seq[ag, ad])
+                a_cols = {f: np.ascontiguousarray(
+                    getattr(outbox, "a_" + f)[ag, ad], dtype=np.int32)
+                    for f in ("type", "term", "prev_idx", "prev_term",
+                              "commit", "success", "match")}
+                for d in np.unique(ad[col_rows]).tolist():
+                    rows = col_rows & (ad == d)
+                    b = batch_for(d)
+                    if b.cols is None:
+                        b.cols = ColRecs()
+                    b.cols.a_group = np.ascontiguousarray(
+                        ag[rows], dtype=np.int32)
+                    for f, col in a_cols.items():
+                        setattr(b.cols, "a_" + f, col[rows])
+                    b.cols.a_seq = np.ascontiguousarray(
+                        seq_all[rows], dtype=np.int64)
+            ridx = np.nonzero(rec_rows)[0]
+            rg, rd = ag[ridx], ad[ridx]
+            a_ents_rows = np.asarray(outbox.a_ents[rg, rd]) \
+                if ridx.size else None
+            for i, (g, d, tm, prev, pt, n, cm) in enumerate(
+                    zip(rg.tolist(), rd.tolist(),
+                        np.asarray(outbox.a_term[rg, rd]).tolist(),
+                        np.asarray(outbox.a_prev_idx[rg, rd]).tolist(),
+                        np.asarray(outbox.a_prev_term[rg, rd]).tolist(),
+                        a_n_r[ridx].tolist(),
+                        np.asarray(outbox.a_commit[rg, rd]).tolist())):
+                cu = catchups.pop((g, d), None)
                 if cu is not None:
                     # The device could only offer an empty heartbeat to
                     # this out-of-window follower; substitute the
@@ -802,31 +917,27 @@ class RaftNode:
                     # semantics).
                     batch_for(d).appends.append(cu)
                     continue
-                if mtype == MSG_REQ:
-                    # The device ring can reference positions below the
-                    # payload floor (log-length regression after conflict
-                    # truncation / snapshot install, or a concurrent
-                    # compaction advancing the floor).  try_slice is
-                    # atomic against the compactor; on miss, drop the
-                    # message — the peer is served by catch-up or
-                    # snapshot on a later tick.
-                    payloads = self.payload_log.try_slice(g, prev + 1, n)
-                    if payloads is None:
-                        continue
-                    seq = self._tick_no
-                else:
-                    payloads = []
-                    # Echo the seq of the request this response answers
-                    # (the device consumed exactly the staged slot from
-                    # dst d this tick) — ReadIndex round binding.
-                    req = self._tick_apps.get((g, d))
-                    seq = req.seq if req is not None else 0
+                # The device ring can reference positions below the
+                # payload floor (log-length regression after conflict
+                # truncation / snapshot install, or a concurrent
+                # compaction advancing the floor).  try_slice is
+                # atomic against the compactor; on miss, drop the
+                # message — the peer is served by catch-up or
+                # snapshot on a later tick.
+                payloads = self.payload_log.try_slice(g, prev + 1, n)
+                if payloads is None:
+                    continue
                 batch_for(d).appends.append(AppendRec(
-                    group=g, type=mtype, term=tm,
+                    group=g, type=MSG_REQ, term=tm,
                     prev_idx=prev, prev_term=pt,
                     ent_terms=a_ents_rows[i, :n].tolist(),
-                    payloads=payloads, commit=cm, success=su, match=ma,
-                    seq=seq))
+                    payloads=payloads, commit=cm,
+                    seq=self._tick_no))
+            if catchups:
+                emitted_mask = np.zeros(
+                    (cfg.num_groups, self.num_nodes), bool)
+                emitted_mask[ag, ad] = True
+                emitted = {k for k in catchups if emitted_mask[k]}
         for (g, d), cu in catchups.items():
             if (g, d) in emitted:
                 # The device emitted a (response) message for this slot;
@@ -905,6 +1016,9 @@ class RaftNode:
                                        + len(batch.appends)
                                        + len(batch.proposals)
                                        + len(batch.snapshots))
+            if batch.cols is not None:
+                self.metrics.msgs_sent += (batch.cols.n_votes()
+                                           + batch.cols.n_appends())
 
     def _publish_phase(self, info) -> None:
         # Vectorized group selection: only groups whose commit advanced

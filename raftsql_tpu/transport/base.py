@@ -85,16 +85,63 @@ class SnapshotRec:
 
 
 @dataclass
+class ColRecs:
+    """Columnar (struct-of-arrays) payload-free messages — the host-plane
+    fast path.
+
+    Per-record Python objects dominate the durable tick at scale: every
+    leader group emits P-1 heartbeat appends per heartbeat tick and every
+    follower answers each, so message count is O(G) regardless of load
+    (~20-40 µs of build+stage Python per record).  Votes and payload-free
+    appends (heartbeats, all responses) instead ride as parallel numpy
+    int column arrays: the sender fancy-indexes them straight out of the
+    device outbox, the receiver scatters them straight into its staging
+    arrays, and the wire format is the raw little-endian array bytes
+    (codec.py).  Payload-carrying appends, proposals, and snapshots keep
+    the record path — their count is proportional to real traffic.
+
+    This is SURVEY.md §2b V2's struct-of-arrays wire contract applied to
+    the host plane end-to-end, not just the device boundary.
+    """
+    # Vote rows (all vote messages):
+    v_group: "object" = None    # np.ndarray [Nv] i32
+    v_type: "object" = None
+    v_term: "object" = None
+    v_last_idx: "object" = None
+    v_last_term: "object" = None
+    v_granted: "object" = None  # i32 0/1
+    # Payload-free append rows (n == 0: heartbeats + responses):
+    a_group: "object" = None    # np.ndarray [Na] i32
+    a_type: "object" = None
+    a_term: "object" = None
+    a_prev_idx: "object" = None
+    a_prev_term: "object" = None
+    a_commit: "object" = None
+    a_success: "object" = None  # i32 0/1
+    a_match: "object" = None
+    a_seq: "object" = None      # i64 (ReadIndex round binding)
+
+    def n_votes(self) -> int:
+        return 0 if self.v_group is None else len(self.v_group)
+
+    def n_appends(self) -> int:
+        return 0 if self.a_group is None else len(self.a_group)
+
+
+@dataclass
 class TickBatch:
     """Everything one node sends another for one tick."""
     votes: List[VoteRec] = field(default_factory=list)
     appends: List[AppendRec] = field(default_factory=list)
     proposals: List[ProposalRec] = field(default_factory=list)
     snapshots: List[SnapshotRec] = field(default_factory=list)
+    cols: "ColRecs | None" = None
 
     def empty(self) -> bool:
         return not (self.votes or self.appends or self.proposals
-                    or self.snapshots)
+                    or self.snapshots
+                    or (self.cols is not None
+                        and (self.cols.n_votes() or self.cols.n_appends())))
 
 
 class Transport(Protocol):

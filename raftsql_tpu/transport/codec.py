@@ -9,21 +9,33 @@ Frame := u32 n_votes  | VoteRec*
          u32 n_appends| AppendRec*
          u32 n_props  | ProposalRec*
          u32 n_snaps  | SnapshotRec*
+         [ColSection]                      (trailing, optional)
 VoteRec     := u32 group | u8 type | q term | q last_idx | q last_term | u8 granted
 AppendRec   := u32 group | u8 type | q term | q prev_idx | q prev_term
              | q commit | u8 success | q match | q seq | u16 n
              | q ent_term * n | (u32 len | bytes) * n_payloads(=n for REQ, 0 resp)
 ProposalRec := u32 group | u32 len | bytes
 SnapshotRec := u32 group | q last_idx | q last_term | q term | u32 len | bytes
+ColSection  := u32 nv | (i32[nv] per field: v_group v_type v_term v_last_idx
+                         v_last_term v_granted — fields omitted when nv==0)
+             | u32 na | (i32[na] per field: a_group a_type a_term a_prev_idx
+                         a_prev_term a_commit a_success a_match,
+                         then i64[na] a_seq — omitted when na==0)
+The ColSection carries the columnar payload-free fast path (base.py
+ColRecs): raw little-endian array dumps, decoded with zero per-record
+work.  Decoders treat trailing bytes after the snapshot section as a
+ColSection; its presence is optional for senders.
 """
 from __future__ import annotations
 
 import struct
 from typing import List, Tuple
 
+import numpy as np
+
 from raftsql_tpu.config import MSG_REQ
-from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
-                                        TickBatch, VoteRec)
+from raftsql_tpu.transport.base import (AppendRec, ColRecs, ProposalRec,
+                                        SnapshotRec, TickBatch, VoteRec)
 
 _U32 = struct.Struct("<I")
 _VOTE = struct.Struct("<IBqqqB")
@@ -59,7 +71,28 @@ def encode_batch(batch: TickBatch) -> bytes:
         out.append(_SNAP.pack(s.group, s.last_idx, s.last_term, s.term))
         out.append(_PLEN.pack(len(s.blob)))
         out.append(s.blob)
+    # Columnar section (trailing, optional): raw little-endian array
+    # bytes — no per-record packing at all (base.py ColRecs).
+    c = batch.cols
+    if c is not None and (c.n_votes() or c.n_appends()):
+        out.append(_U32.pack(c.n_votes()))
+        if c.n_votes():
+            for f in _COL_V:
+                out.append(np.ascontiguousarray(
+                    getattr(c, f), dtype=np.int32).tobytes())
+        out.append(_U32.pack(c.n_appends()))
+        if c.n_appends():
+            for f in _COL_A:
+                out.append(np.ascontiguousarray(
+                    getattr(c, f),
+                    dtype=np.int64 if f == "a_seq" else np.int32).tobytes())
     return b"".join(out)
+
+
+_COL_V = ("v_group", "v_type", "v_term", "v_last_idx", "v_last_term",
+          "v_granted")
+_COL_A = ("a_group", "a_type", "a_term", "a_prev_idx", "a_prev_term",
+          "a_commit", "a_success", "a_match", "a_seq")
 
 
 def decode_batch(blob: bytes) -> TickBatch:
@@ -111,4 +144,19 @@ def decode_batch(blob: bytes) -> TickBatch:
                 group=g, last_idx=li, last_term=lt, term=term,
                 blob=blob[off:off + blen]))
             off += blen
+    if off < len(blob):
+        cols = ColRecs()
+        (nv_,) = take(_U32)
+        for f in _COL_V:
+            arr = np.frombuffer(blob, np.dtype("<i4"), nv_, off)
+            setattr(cols, f, arr)
+            off += 4 * nv_
+        (na_,) = take(_U32)
+        for f in _COL_A:
+            dt = np.dtype("<i8") if f == "a_seq" else np.dtype("<i4")
+            arr = np.frombuffer(blob, dt, na_, off)
+            setattr(cols, f, arr)
+            off += dt.itemsize * na_
+        if nv_ or na_:
+            batch.cols = cols
     return batch

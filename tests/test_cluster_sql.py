@@ -332,3 +332,78 @@ def test_follower_catchup_beyond_ring_window(tmp_path):
         for db in dbs:
             if db is not None:
                 db.close()
+
+
+def test_follower_catchup_below_table_floor(tmp_path):
+    """A follower whose next_idx falls below the leader's term-transition
+    table floor (more than K transitions behind — here K=2 with repeated
+    re-elections) is unservable by device appends even INSIDE the ring
+    window: the send guard (core/step.py in_window) suppresses real
+    batches, so without the floor clause in _build_catchups' lag test
+    the follower would see empty heartbeats forever.  The leader host
+    must feed it catch-up appends from the payload log."""
+    hub = LoopbackHub()
+    cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=TICK,
+                     log_window=32, max_entries_per_msg=4,
+                     term_table_slots=2)
+    dirs = [str(tmp_path / f"raftsql-{i + 1}") for i in range(3)]
+    paths = [str(tmp_path / f"fl-{i}.db") for i in range(3)]
+
+    def boot(i):
+        pipe = RaftPipe.create(i + 1, 3, cfg, LoopbackTransport(hub),
+                               data_dir=dirs[i])
+        return RaftDB(lambda g, i=i: SQLiteStateMachine(paths[i]), pipe)
+
+    dbs = [boot(i) for i in range(3)]
+    inserted = 0
+    try:
+        err = dbs[0].propose("CREATE TABLE main.t (v int)").wait(TIMEOUT)
+        assert err is None, err
+        dbs[2].close()
+        dbs[2] = None
+
+        def put(n):
+            nonlocal inserted
+            for _ in range(n):
+                err = None
+                for src in (0, 1) * 5:      # whichever is up forwards to
+                    if dbs[src] is None:    # the current leader
+                        continue
+                    err = dbs[src].propose(
+                        f"INSERT INTO main.t (v) VALUES ({inserted})"
+                    ).wait(TIMEOUT)
+                    if err is None:
+                        break
+                assert err is None, err
+                inserted += 1
+
+        # K+1 = 3 term transitions while node 2 is down, with a few
+        # entries each so every transition stays inside the ring window
+        # — the floor (oldest of the last K=2 transitions) then sits
+        # ABOVE node 2's position while the ring still covers it.
+        # Alternate WHICH of the live pair restarts: the survivor wins
+        # the next election, so every cycle really bumps the term.
+        put(2)
+        for cyc in range(3):
+            i = cyc % 2
+            dbs[i].close()
+            dbs[i] = None
+            time.sleep(40 * TICK)
+            dbs[i] = boot(i)
+            put(2)
+        dbs[2] = boot(2)
+        deadline = time.monotonic() + TIMEOUT
+        while True:
+            v = dbs[2].query("SELECT count(*) from main.t")
+            if v == f"|{inserted}|\n":
+                break
+            assert time.monotonic() < deadline, \
+                f"follower stalled below the table floor at {v!r}"
+            time.sleep(0.02)
+        assert any(db is not None
+                   and db.metrics()["catchup_appends"] > 0
+                   for db in dbs)
+    finally:
+        for db in dbs:
+            if db is not None:
+                db.close()

@@ -264,7 +264,7 @@ class TestRingAliasGuard:
         # Snapshot-installed state: log == commit == 57, ring cleared
         # except the boundary slot (term 2).  Slot (41-1) % 16 ==
         # slot (57-1) % 16, so term_at(41) aliases the boundary.
-        st = install_snapshot_state(st, 0, 57, 2, W, 2)
+        st = install_snapshot_state(st, 0, 57, 2, 2)
         ib = empty_inbox(cfg)
         ib = ib._replace(
             a_type=ib.a_type.at[0, 0].set(MSG_REQ),
@@ -346,3 +346,70 @@ class TestRinglessConfig:
             assert (np.asarray(getattr(a, f))
                     == np.asarray(getattr(b, f))).all(), f
         assert (np.asarray(a.commit) > 0).any()
+
+
+class TestFloorResync:
+    """A restarted/installed follower whose table floor is far above the
+    leader's serving point must steer the leader UP, not down: the
+    floor-reject hints the follower's full log length, and the leader
+    treats a hint at-or-beyond its send point as a resync jump.
+    Without either half, the pair livelocks on rejects at prev=0
+    (found by the flaky tail of test_follower_catchup_below_table_floor
+    at floors <= E; this covers the floor > E case the cluster test
+    cannot reach)."""
+
+    def test_floor_reject_hints_full_log_len(self):
+        from raftsql_tpu.config import MSG_REQ, MSG_RESP
+        from raftsql_tpu.core.state import (empty_inbox,
+                                            install_snapshot_state,
+                                            init_peer_state)
+        from raftsql_tpu.core.step import peer_step
+
+        cfg = small_cfg(num_groups=1, log_window=16, max_entries_per_msg=4)
+        st = init_peer_state(cfg, 1)
+        st = install_snapshot_state(st, 0, 57, 2, 2)   # floor = 57 >> E
+        ib = empty_inbox(cfg)
+        # Leader's empty heartbeat at prev=0 (its floor-suppressed
+        # fallback for an unservable follower).
+        ib = ib._replace(
+            a_type=ib.a_type.at[0, 0].set(MSG_REQ),
+            a_term=ib.a_term.at[0, 0].set(2),
+            a_commit=ib.a_commit.at[0, 0].set(57))
+        st2, out, info = peer_step(cfg, st, ib,
+                                   jnp.zeros((1,), jnp.int32),
+                                   jnp.asarray(1, jnp.int32))
+        assert int(info.app_from[0]) == -1, "below-floor hb accepted"
+        assert int(out.a_type[0, 0]) == MSG_RESP
+        assert not bool(out.a_success[0, 0])
+        assert int(out.a_match[0, 0]) == 57, \
+            "floor reject must hint the full log length"
+
+    def test_leader_jumps_next_idx_on_resync_hint(self):
+        from raftsql_tpu.config import LEADER, MSG_RESP
+        from raftsql_tpu.core.state import empty_inbox, init_peer_state
+        from raftsql_tpu.core.step import peer_step
+
+        cfg = small_cfg(num_groups=1, log_window=16, max_entries_per_msg=4)
+        st = init_peer_state(cfg, 0)
+        st = st._replace(
+            term=st.term.at[0].set(2),
+            role=st.role.at[0].set(LEADER),
+            log_len=st.log_len.at[0].set(60),
+            commit=st.commit.at[0].set(60),
+            tbl_pos=st.tbl_pos.at[0, -1].set(1),
+            tbl_term=st.tbl_term.at[0, -1].set(2),
+            match=st.match.at[0].set(jnp.asarray([60, 0, 0], jnp.int32)),
+            next_idx=st.next_idx.at[0].set(
+                jnp.asarray([61, 1, 61], jnp.int32)))
+        ib = empty_inbox(cfg)
+        # Follower 1's floor-reject of our prev=0 probe: hint 57 >= our
+        # next_idx 1 -> resync jump to 58 (not a walk to 1).
+        ib = ib._replace(
+            a_type=ib.a_type.at[0, 1].set(MSG_RESP),
+            a_term=ib.a_term.at[0, 1].set(2),
+            a_success=ib.a_success.at[0, 1].set(False),
+            a_match=ib.a_match.at[0, 1].set(57))
+        st2, out, info = peer_step(cfg, st, ib,
+                                   jnp.zeros((1,), jnp.int32),
+                                   jnp.asarray(0, jnp.int32))
+        assert int(st2.next_idx[0, 1]) == 58, int(st2.next_idx[0, 1])

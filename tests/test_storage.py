@@ -232,6 +232,61 @@ class TestCodec:
         out = decode_batch(encode_batch(batch))
         assert out == batch
 
+    def test_columnar_roundtrip(self):
+        import numpy as np
+
+        from raftsql_tpu.transport.base import ColRecs
+
+        def cols(nv, na):
+            c = ColRecs()
+            if nv:
+                c.v_group = np.arange(nv, dtype=np.int32)
+                c.v_type = np.full(nv, MSG_REQ, np.int32)
+                c.v_term = np.arange(nv, dtype=np.int32) + 3
+                c.v_last_idx = np.arange(nv, dtype=np.int32) * 2
+                c.v_last_term = np.arange(nv, dtype=np.int32)
+                c.v_granted = (np.arange(nv, dtype=np.int32) % 2)
+            if na:
+                c.a_group = np.arange(na, dtype=np.int32) + 1
+                c.a_type = np.full(na, MSG_RESP, np.int32)
+                c.a_term = np.arange(na, dtype=np.int32) + 9
+                c.a_prev_idx = np.arange(na, dtype=np.int32)
+                c.a_prev_term = np.arange(na, dtype=np.int32)
+                c.a_commit = np.arange(na, dtype=np.int32) * 3
+                c.a_success = (np.arange(na, dtype=np.int32) % 2)
+                c.a_match = np.arange(na, dtype=np.int32) + 5
+                c.a_seq = np.arange(na, dtype=np.int64) + (1 << 40)
+            return c
+
+        for nv, na in ((2, 3), (2, 0), (0, 3)):
+            # Mixed with record sections: both must survive together.
+            b = TickBatch(appends=[AppendRec(
+                group=0, type=MSG_REQ, term=1, ent_terms=[1],
+                payloads=[b"x"], seq=4)])
+            b.cols = cols(nv, na)
+            out = decode_batch(encode_batch(b))
+            assert out.appends == b.appends
+            assert (out.cols is not None) == bool(nv or na)
+            for f in ("v_group", "v_type", "v_term", "v_last_idx",
+                      "v_last_term", "v_granted"):
+                want = getattr(b.cols, f)
+                got = getattr(out.cols, f)
+                if nv:
+                    assert (np.asarray(got) == np.asarray(want)).all(), f
+                else:
+                    assert got is None or len(got) == 0
+            for f in ("a_group", "a_type", "a_term", "a_prev_idx",
+                      "a_prev_term", "a_commit", "a_success", "a_match",
+                      "a_seq"):
+                want = getattr(b.cols, f)
+                got = getattr(out.cols, f)
+                if na:
+                    assert (np.asarray(got) == np.asarray(want)).all(), f
+                    if f == "a_seq":
+                        assert got.dtype == np.int64
+                else:
+                    assert got is None or len(got) == 0
+
     def test_empty(self):
         assert decode_batch(encode_batch(TickBatch())).empty()
 
