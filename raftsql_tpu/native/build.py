@@ -95,6 +95,13 @@ def load_native_wal():
         lib.wal_set_hardstate.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_int64, ctypes.c_uint64]
+        lib.wal_set_hardstates.restype = ctypes.c_int
+        lib.wal_set_hardstates.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64)]
         lib.wal_set_snapshot.restype = ctypes.c_int
         lib.wal_set_snapshot.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,

@@ -181,6 +181,28 @@ int wal_set_hardstate(void* h, uint32_t group, uint64_t term, int64_t vote,
   return 0;
 }
 
+// Batched hard states — one call per tick for every group whose
+// (term, vote, commit) changed; under saturation that is ALL groups, so
+// the per-record Python/ctypes round trip must not be per group.
+int wal_set_hardstates(void* h, uint32_t n, const uint32_t* groups,
+                       const uint64_t* terms, const int64_t* votes,
+                       const uint64_t* commits) {
+  Wal* w = static_cast<Wal*>(h);
+  std::lock_guard<std::mutex> lk(w->mu);
+  std::vector<uint8_t> body;
+  for (uint32_t i = 0; i < n; ++i) {
+    body.clear();
+    body.reserve(29);
+    body.push_back(2);
+    put_u32(body, groups[i]);
+    put_u64(body, terms[i]);
+    put_u64(body, uint64_t(votes[i]));
+    put_u64(body, commits[i]);
+    frame(w, body);
+  }
+  return 0;
+}
+
 // Durable point: write all pending frames, then fdatasync.  Returns 0 on
 // success, -1 on error (caller must treat as fatal — the ordering
 // invariant is broken if we proceed).

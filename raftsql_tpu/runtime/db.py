@@ -41,6 +41,19 @@ from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
 
+def _expand_commit_item(item):
+    """Normalize a commit_q item to per-entry (group, index, sql) tuples.
+
+    The live publish phase enqueues per-GROUP batches
+    (group, [(index, sql), ...]) so the tick thread pays one queue put
+    per group; WAL replay enqueues per-entry 3-tuples (the nil-sentinel
+    counting protocol must stay item-accurate there)."""
+    if len(item) == 2:
+        g = item[0]
+        return [(g, i, s) for (i, s) in item[1]]
+    return [item]
+
+
 class NotLeaderError(Exception):
     """A linearizable read hit a non-leader; retry at `leader` (1-based
     node id, 0 = unknown)."""
@@ -178,7 +191,11 @@ class RaftDB:
             # joins this item's group-committed batch.  The replay pass
             # must stay strictly item-at-a-time — draining could swallow
             # live entries beyond the nil sentinel it returns at.
-            run = [item]
+            # Items arrive per-entry (group, index, sql) from replay, or
+            # as per-group batches (group, [(index, sql), ...]) from the
+            # live publish phase (runtime/node.py) — expanded HERE so
+            # the tick thread pays one queue put per group.
+            run = _expand_commit_item(item)
             stop = False
             if not replay:
                 while len(run) < 256:
@@ -197,7 +214,7 @@ class RaftDB:
                     if nxt is CLOSED:
                         stop = True
                         break
-                    run.append(nxt)
+                    run.extend(_expand_commit_item(nxt))
             if run:
                 self._apply_run(run)
             if stop:

@@ -20,7 +20,12 @@ from raftsql_tpu.runtime.node import RaftNode
 class RaftPipe:
     def __init__(self, node: RaftNode):
         self.node = node
-        self.commit_q = node.commit_q   # (group, index, sql)|None|CLOSED
+        # Items: (group, index, sql) per-entry (replay), or the batch
+        # form (group, [(index, sql), ...]) from the live publish phase
+        # (one put per group per tick); None = replay-done sentinel,
+        # CLOSED = stream end.  Consumers normalize via
+        # runtime.db._expand_commit_item.
+        self.commit_q = node.commit_q
 
     @classmethod
     def create(cls, node_id: int, num_nodes: int, cfg, transport,

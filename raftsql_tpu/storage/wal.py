@@ -272,6 +272,35 @@ class WAL:
             return
         self._write(_HARD.pack(REC_HARDSTATE, group, term, vote, commit))
 
+    def set_hardstates(self, groups, terms, votes, commits) -> None:
+        """Batched hard-state records from parallel arrays — one native
+        call for the whole tick (under saturation EVERY group's commit
+        advances per tick, and a per-group ctypes round trip was ~40% of
+        the durable WAL phase)."""
+        n = len(groups)
+        if n == 0:
+            return
+        if self._lib is None:
+            for g, t, v, c in zip(groups, terms, votes, commits):
+                self.set_hardstate(int(g), int(t), int(v), int(c))
+            return
+        import ctypes
+
+        import numpy as np
+        ga = np.ascontiguousarray(groups, np.uint32)
+        self._active_stats.hs.update(ga.tolist())
+        ta = np.ascontiguousarray(terms, np.uint64)
+        va = np.ascontiguousarray(votes, np.int64)
+        ca = np.ascontiguousarray(commits, np.uint64)
+        self._lib.wal_set_hardstates(
+            self._h, n,
+            ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ta.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            va.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        self._pending = True
+        self._bytes += n * (_HDR.size + _HARD.size)
+
     def set_snapshot(self, group: int, index: int, term: int) -> None:
         """InstallSnapshot boundary marker: on replay, entries of `group`
         at or below `index` AND the retained suffix are dropped — the

@@ -430,3 +430,37 @@ class TestNativeWAL:
         w2.close()
         groups = WAL.replay(d)
         assert [e[1] for e in groups[0].entries] == [b"one", b"two"]
+
+
+class TestBatchedHardstates:
+    def test_batched_hardstates_replay(self, tmp_path):
+        """set_hardstates (one native call per tick) must replay exactly
+        like per-group set_hardstate, including NO_VOTE (-1) votes."""
+        import numpy as np
+        d = str(tmp_path / "hsb")
+        w = WAL(d)
+        w.append_entry(0, 1, 1, b"a")
+        w.append_entry(2, 1, 1, b"b")
+        w.set_hardstates(np.asarray([0, 2, 5]),
+                         np.asarray([3, 4, 9]),
+                         np.asarray([-1, 1, 0]),
+                         np.asarray([1, 1, 0]))
+        w.sync()
+        w.close()
+        groups = WAL.replay(d)
+        h0, h2, h5 = groups[0].hard, groups[2].hard, groups[5].hard
+        assert (h0.term, h0.vote, h0.commit) == (3, -1, 1)
+        assert (h2.term, h2.vote, h2.commit) == (4, 1, 1)
+        assert (h5.term, h5.vote, h5.commit) == (9, 0, 0)
+
+    def test_batched_hardstates_python_fallback(self, tmp_path):
+        import numpy as np
+        d = str(tmp_path / "hsf")
+        w = WAL(d, native=False)
+        assert w._lib is None
+        w.set_hardstates(np.asarray([1]), np.asarray([7]),
+                         np.asarray([-1]), np.asarray([5]))
+        w.sync()
+        w.close()
+        h = WAL.replay(d)[1].hard
+        assert (h.term, h.vote, h.commit) == (7, -1, 5)
