@@ -219,6 +219,12 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
     return best
 
 
+def _light_row(sweep: dict) -> dict:
+    """The light-load row of a latency sweep (labels carry a G suffix)."""
+    return next((v for k, v in sweep.items() if k.startswith("light_1")),
+                {})
+
+
 def bench_latency_sweep(groups: int, peers: int, repeats: int) -> dict:
     """Propose→commit latency at light / half / saturating load.
 
@@ -613,8 +619,7 @@ def run_config(config: str, cpu: bool):
         return (max(vals) if vals else 0.0), {"rules": out}
     if config == "latency":
         sweep = bench_latency_sweep(groups, peers, repeats)
-        return (sweep.get("light_1", {}).get("p50_ms") or 0.0,
-                {"lat": sweep})
+        return (_light_row(sweep).get("p50_ms") or 0.0, {"lat": sweep})
     if config == "durable":
         # sqlite keeps one DB file (3 fds with -wal/-shm) per group: stay
         # well under the default open-files rlimit.
@@ -631,9 +636,7 @@ def run_config(config: str, cpu: bool):
     if os.environ.get("BENCH_SKIP_SWEEP") != "1":
         sweep = bench_latency_sweep(groups, peers, max(1, repeats - 1))
         extras["lat"] = sweep
-        light = next((v for k, v in sweep.items()
-                      if k.startswith("light_1")), {})
-        extras["p50_light_ms"] = light.get("p50_ms")
+        extras["p50_light_ms"] = _light_row(sweep).get("p50_ms")
     return value, extras
 
 
@@ -800,7 +803,11 @@ def main() -> None:
                     continue
                 got = _attempt(
                     "", min(timeout_s, remaining() - fallback_reserve),
+                    # No per-rung latency sweep: each extra shape costs
+                    # ~2 slow tunnel compiles and timed out whole rungs;
+                    # one dedicated latency child runs after the ladder.
                     extra_env={"BENCH_GROUPS": G,
+                               "BENCH_SKIP_SWEEP": "1",
                                "BENCH_TICKS": os.environ.get(
                                    "BENCH_TICKS", "400")},
                     label=f"tpu-G{G}-p{pass_no}")
@@ -838,6 +845,22 @@ def main() -> None:
             extra_env={"BENCH_CONFIG": "durable"},
             label="durable-cpu")
 
+    # -- 3b. latency child on the device: ONE small shape (G=1024, E=16)
+    # where the 3-tick pipeline meets the <2 ms p50 target; its own
+    # child so a fault cannot cost the headline and the ladder rungs
+    # stay single-shape (sweep compiles timed out rung
+    # children).  Runs AFTER the cheap durable child so a slow sweep
+    # cannot burn the budget the durable evidence needs.
+    latc = None
+    if results and remaining() > fallback_reserve + 180 \
+            and os.environ.get("BENCH_SKIP_SWEEP") != "1":
+        latc = _attempt(
+            "", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "latency", "BENCH_GROUPS": "1024",
+                       "BENCH_REPEATS": "2"},
+            label="latency-G1024")
+
+
     if results:
         # Headline = best commits/s across the ladder (the throughput
         # curve peaks near G=32k and flattens; "largest G that ran" was
@@ -851,6 +874,10 @@ def main() -> None:
             for g in ladder}
         if rules:
             parsed["rules"] = rules.get("rules")
+        if latc:
+            parsed["lat"] = latc.get("lat")
+            # 0.0 means "sweep measured nothing", not a passed target.
+            parsed["p50_light_ms"] = latc.get("value") or None
         if durable:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")

@@ -25,16 +25,27 @@ from raftsql_tpu.transport.faults import partition_peer, random_drop
 
 
 def window_terms(states, cfg):
-    """[P, G, L] materialized log terms (L = max log_len), 0 beyond len."""
+    """[P, G, L] materialized log terms (L = max log_len), 0 beyond len.
+
+    Reads the ring when present; with keep_ring=False (the benchmark
+    configuration, [G, 1] stub) reads the O(K) transition table instead —
+    the engine's own read path."""
+    from raftsql_tpu.core.state import term_at_tbl
+
+    ringless = states.log_term.shape[-1] == 1
     L = int(np.asarray(states.log_len).max())
     if L == 0:
         return np.zeros((cfg.num_peers, cfg.num_groups, 0), np.int64)
     idx = jnp.arange(1, L + 1, dtype=jnp.int32)[None, :]
     out = []
     for p in range(cfg.num_peers):
-        t = term_at(states.log_term[p], states.log_len[p],
-                    jnp.broadcast_to(idx, (cfg.num_groups, L)),
-                    cfg.log_window)
+        idxb = jnp.broadcast_to(idx, (cfg.num_groups, L))
+        if ringless:
+            t = term_at_tbl(states.tbl_pos[p], states.tbl_term[p],
+                            states.log_len[p], idxb)
+        else:
+            t = term_at(states.log_term[p], states.log_len[p], idxb,
+                        cfg.log_window)
         out.append(np.asarray(t))
     return np.stack(out)
 
@@ -54,7 +65,14 @@ class InvariantChecker:
         commit = np.asarray(states.commit)
         log_len = np.asarray(states.log_len)
         terms = window_terms(states, cfg)    # [P, G, L]
-        self.check_table_matches_ring(states, t)
+        ringless = states.log_term.shape[-1] == 1
+        if not ringless:
+            self.check_table_matches_ring(states, t)
+        if ringless:
+            # The table forgets positions below its floor (the ring
+            # path computes its own floor from log_len - W).
+            from raftsql_tpu.core.state import tbl_floor
+            tblf = np.asarray(tbl_floor(states.tbl_pos, states.log_len))
 
         for g in range(cfg.num_groups):
             # Election safety.
@@ -73,9 +91,14 @@ class InvariantChecker:
                 # The device ring only holds the last W entries: position
                 # i's slot is recycled by position i+W once log_len passes
                 # it, so terms read for positions <= log_len - W are
-                # aliased garbage, not engine state.  Check (and extend
-                # history) only over ring-observable positions.
-                floor = max(0, int(log_len[p, g]) - cfg.log_window)
+                # aliased garbage, not engine state.  The ringless config
+                # reads the table, which forgets positions below its
+                # floor instead.  Check (and extend history) only over
+                # observable positions.
+                if ringless:
+                    floor = max(0, int(tblf[p, g]) - 1)
+                else:
+                    floor = max(0, int(log_len[p, g]) - cfg.log_window)
                 overlap = min(len(hist), c)
                 assert hist[floor:overlap] == pterms[floor:overlap], (
                     f"t={t} g={g} p={p}: committed prefix diverged: "
@@ -234,3 +257,16 @@ class TestSafetyUnderChaos:
                 tick(zero)
             commit_after = int(np.asarray(states.commit)[:, 0].max())
             assert commit_after >= commit_before, "committed data lost"
+
+
+class TestRinglessChaos:
+    def test_invariants_ringless_config(self):
+        """The benchmark's keep_ring=False configuration must satisfy the
+        same safety invariants under drops + partitions — the checker
+        reads terms through the engine's own transition table."""
+        cfg = RaftConfig(seed=17, keep_ring=False, **CFG)
+        sched = [(30, 60, 2), (80, 110, 1)]
+        states, _ = run_chaos(cfg, 180, p_drop=0.15,
+                              partition_schedule=sched, seed=17)
+        assert states.log_term.shape[-1] == 1
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
