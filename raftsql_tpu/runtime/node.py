@@ -689,18 +689,24 @@ class RaftNode:
             n_acc = int(prop_acc[g])
             if noop[g] or n_acc:
                 base = int(info.prop_base[g])
+                t_g = int(term[g])
                 if noop[g]:
-                    put_rec(g, base, int(term[g]), b"")
-                    self.payload_log.put(g, base, [b""], [int(term[g])])
+                    put_rec(g, base, t_g, b"")
+                    self.payload_log.put(g, base, [b""], [t_g])
                 if n_acc:
                     with self._prop_lock:
                         batch = [self._props[g].popleft()
                                  for _ in range(n_acc)]
-                    for i, data in enumerate(batch):
-                        put_rec(g, base + 1 + i, int(term[g]), data)
-                        self._local[g].append((base + 1 + i, data))
+                    # Batched list extends: per-record put_rec calls
+                    # were ~20% of this phase at saturation.
+                    w_groups.extend([g] * n_acc)
+                    w_idx.extend(range(base + 1, base + 1 + n_acc))
+                    w_terms.extend([t_g] * n_acc)
+                    w_data.extend(batch)
+                    self._local[g].extend(
+                        zip(range(base + 1, base + 1 + n_acc), batch))
                     self.payload_log.put(g, base + 1, batch,
-                                         [int(term[g])] * n_acc)
+                                         [t_g] * n_acc)
                 self.metrics.proposals += n_acc
             src = int(app_from[g])
             if src >= 0:
@@ -709,9 +715,11 @@ class RaftNode:
                     continue         # re-delivers — raft tolerates loss
                 start = int(info.app_start[g])
                 new_len = int(info.new_log_len[g])
-                for i in range(int(info.app_n[g])):
-                    put_rec(g, start + i, rec.ent_terms[i],
-                            rec.payloads[i])
+                n_app = int(info.app_n[g])
+                w_groups.extend([g] * n_app)
+                w_idx.extend(range(start, start + n_app))
+                w_terms.extend(rec.ent_terms[:n_app])
+                w_data.extend(rec.payloads[:n_app])
                 self.payload_log.put(g, start, rec.payloads,
                                      rec.ent_terms, new_len=new_len)
                 if info.app_conflict[g] and self._local[g]:
@@ -1043,18 +1051,27 @@ class RaftNode:
                     f"g{g}: payload log shorter than commit "
                     f"({a}+{len(datas)} < {c})")
             items = []
+            # Hoisted per-group lookups: every entry is enveloped (wrap()
+            # at propose time gives forward-retry dedup its ids), so the
+            # per-entry cost is the unwrap + dedup chain itself — inline
+            # it rather than paying a _decode_entry call per entry
+            # (~4 µs each, half this phase at saturation).
+            dedup_seen = self._dedup[g].seen
             for off, data in enumerate(datas):
                 idx = a + 1 + off
-                if data and fwd:
+                if not data:
+                    continue
+                if fwd:
                     # Forwarded proposal observed committed: retire it
                     # (exact match — envelope ids are unique).
                     for k, (p, _) in enumerate(fwd):
                         if p == data:
                             del fwd[k]
                             break
-                sql = self._decode_entry(g, data, idx)
-                if sql is not None:
-                    items.append((idx, sql))
+                pid, payload = unwrap(data)
+                if pid is not None and dedup_seen(pid, idx):
+                    continue
+                items.append((idx, payload.decode("utf-8")))
             if items:
                 # One queue put per group per tick (batch form
                 # (g, [(idx, sql), ...]); pipe.commit_q contract): at

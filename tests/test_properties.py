@@ -32,7 +32,7 @@ def window_terms(states, cfg):
     the engine's own read path."""
     from raftsql_tpu.core.state import term_at_tbl
 
-    ringless = states.log_term.shape[-1] == 1
+    ringless = not cfg.keep_ring
     L = int(np.asarray(states.log_len).max())
     if L == 0:
         return np.zeros((cfg.num_peers, cfg.num_groups, 0), np.int64)
@@ -65,14 +65,14 @@ class InvariantChecker:
         commit = np.asarray(states.commit)
         log_len = np.asarray(states.log_len)
         terms = window_terms(states, cfg)    # [P, G, L]
-        ringless = states.log_term.shape[-1] == 1
-        if not ringless:
-            self.check_table_matches_ring(states, t)
+        ringless = not cfg.keep_ring
         if ringless:
             # The table forgets positions below its floor (the ring
             # path computes its own floor from log_len - W).
             from raftsql_tpu.core.state import tbl_floor
             tblf = np.asarray(tbl_floor(states.tbl_pos, states.log_len))
+        else:
+            self.check_table_matches_ring(states, t)
 
         for g in range(cfg.num_groups):
             # Election safety.
