@@ -823,18 +823,6 @@ def main() -> None:
              f"{ {g: round(r['value'], 1) for g, r in results.items()} } "
              f"faults {faults}")
 
-    # -- 2b. commit-rule race on the device (point vs windowed vs
-    # pallas-compiled), at a mid-ladder shape so a kernel fault in one
-    # rule cannot cost the headline.
-    rules = None
-    if results and remaining() > fallback_reserve + 240 \
-            and os.environ.get("BENCH_SKIP_RULES") != "1":
-        rules_g = min(max(results), 10_000)
-        rules = _attempt(
-            "", min(timeout_s, remaining() - fallback_reserve),
-            extra_env={"BENCH_CONFIG": "rules", "BENCH_GROUPS": rules_g,
-                       "BENCH_TICKS": "200", "BENCH_REPEATS": "2"},
-            label=f"rules-G{rules_g}")
 
     # -- 3. durable-path child (host runtime measured on cpu).
     durable = None
@@ -859,6 +847,21 @@ def main() -> None:
             extra_env={"BENCH_CONFIG": "latency", "BENCH_GROUPS": "1024",
                        "BENCH_REPEATS": "2"},
             label="latency-G1024")
+
+    # -- 3c. commit-rule race on the device (point vs windowed vs
+    # pallas-compiled), at a mid-ladder shape so a kernel fault in one
+    # rule cannot cost the headline.  Runs LAST of the children: the
+    # headline, latency-target, and durable evidence all outrank it
+    # under budget pressure.
+    rules = None
+    if results and remaining() > fallback_reserve + 240 \
+            and os.environ.get("BENCH_SKIP_RULES") != "1":
+        rules_g = min(max(results), 10_000)
+        rules = _attempt(
+            "", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "rules", "BENCH_GROUPS": rules_g,
+                       "BENCH_TICKS": "200", "BENCH_REPEATS": "2"},
+            label=f"rules-G{rules_g}")
 
 
     if results:
