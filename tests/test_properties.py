@@ -270,3 +270,18 @@ class TestRinglessChaos:
                               partition_schedule=sched, seed=17)
         assert states.log_term.shape[-1] == 1
         assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+
+class TestFivePeerChaos:
+    def test_invariants_five_peers(self):
+        """P=5 (quorum 3) under drops and a rolling partition: the quorum
+        math, vote tallies, and message slots must hold invariants at the
+        wider peer axis too (the reference's canonical cluster is 3-node,
+        Procfile:2-4; 5-node is the raft paper's other standard size)."""
+        cfg = RaftConfig(num_groups=3, num_peers=5, log_window=64,
+                         max_entries_per_msg=4, election_ticks=10,
+                         heartbeat_ticks=1, seed=23)
+        sched = [(40, 70, 0), (100, 130, 4)]
+        states, _ = run_chaos(cfg, 180, p_drop=0.15,
+                              partition_schedule=sched, seed=23)
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
