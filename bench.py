@@ -21,12 +21,14 @@ G=100k attempt): the process runs as a PARENT that never imports a jax
 backend.  Every measurement is a CHILD subprocess under a hard timeout.
 The parent first PROBES the default platform with a short timeout (a
 wedged remote-TPU tunnel hangs device init indefinitely), then — if the
-probe says tpu — runs a G-ladder (1k → 10k → 100k) smallest-first with
-per-shape fault capture and a second pass over failed shapes, keeping the
-largest succeeding shape as the headline.  A durable-path child (real
-RaftNode cluster: WAL + KV apply + loopback transport) runs on cpu, and a
-cpu headline is the last-resort fallback.  Exit code is ALWAYS 0 with one
-JSON line on stdout.
+probe says tpu — runs a single-shape G-ladder (1k → 10k → 32k → 100k)
+smallest-first with per-shape fault capture and a second pass over failed
+shapes, keeping the BEST-value rung as the headline.  Then, in budget
+priority order: a durable-path child (real RaftNode cluster: WAL + KV
+apply + loopback transport, on cpu), a latency child (G=1024/E=16, the
+<2 ms p50 shape), and the commit-rule race.  A cpu headline is the
+last-resort fallback.  Exit code is ALWAYS 0 with one JSON line on
+stdout.
 
 The reference (chzchzchz/raftsql) publishes no numbers (BASELINE.md); the
 baseline used for `vs_baseline` is the driver-set north star of 1e8
@@ -36,12 +38,16 @@ Environment knobs:
   BENCH_CONFIG   headline | quorum | elections | commit_scan | multichip
                  | rules | latency | durable | all    (default headline)
   BENCH_GROUPS / BENCH_PEERS / BENCH_TICKS / BENCH_REPEATS
-  BENCH_LADDER   comma-separated group counts   (default 1000,10000,100000)
+  BENCH_E        append batch size (headline default 32; latency sweeps
+                 pin 16 via BENCH_LAT_E; BENCH_LAT_GROUPS sets their G)
+  BENCH_LADDER   comma-separated group counts
+                 (default 1000,10000,32768,100000)
+  BENCH_DURABLE_ACTIVE  N groups carrying load in the durable bench
   BENCH_PLATFORM cpu|tpu        (parent: single attempt on this platform)
   BENCH_ATTEMPT_TIMEOUT_S       (default 420, per child attempt)
   BENCH_PROBE_TIMEOUT_S         (default 150, platform probe)
   BENCH_TOTAL_BUDGET_S          (default 1800, whole-parent wall budget)
-  BENCH_SKIP_DURABLE=1 / BENCH_SKIP_SWEEP=1
+  BENCH_SKIP_DURABLE=1 / BENCH_SKIP_SWEEP=1 / BENCH_SKIP_RULES=1
   BENCH_PROFILE  <dir>          (wrap timed runs in jax.profiler.trace)
 """
 from __future__ import annotations
