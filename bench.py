@@ -900,6 +900,11 @@ def main() -> None:
     _log("bench parent: no TPU result; falling back to cpu headline")
     parsed = _attempt("cpu", max(min(timeout_s, remaining() - 30), 120))
     if parsed:
+        # Record WHY the platform is cpu: "timeout" = both device-init
+        # probes hung (a wedged remote-TPU tunnel, the round-1 failure
+        # mode), vs a probed-alive device whose ladder rungs then all
+        # faulted (recorded separately in tpu_faults).
+        parsed["tpu_probe"] = platform if probe else "timeout"
         if faults:
             parsed["tpu_faults"] = {str(g): v for g, v in faults.items()}
         if durable:
