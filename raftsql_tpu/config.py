@@ -36,6 +36,19 @@ MSG_RESP = 2
 MSG_PREREQ = 3
 MSG_PRERESP = 4
 
+# Floor-reject resync marker: a follower that cannot verify an append
+# below its transition-table floor answers with
+# a_match = own_log_len + FLOOR_HINT_BIAS — an EXPLICIT "resync UP to my
+# tip" request (core/step.py Phase 4).  The leader strips the bias and
+# jumps next_idx to hint + 1 (Phase 5); ordinary conflict hints are
+# never biased, so a late in-flight ordinary reject can no longer be
+# mistaken for a resync request (which cost extra probe rounds when the
+# signal was inferred from hint magnitude).  The bias rides the normal
+# i32 match field on both wire forms; log lengths stay far below 2^30
+# (the device ring window W bounds uncommitted depth, and positions are
+# compacted host-side).
+FLOOR_HINT_BIAS = 1 << 30
+
 # voted_for sentinel: no vote cast this term.
 NO_VOTE = -1
 # leader_hint sentinel: leader unknown.

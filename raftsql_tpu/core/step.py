@@ -33,9 +33,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_NONE,
-                                MSG_PREREQ, MSG_PRERESP, MSG_REQ, MSG_RESP,
-                                NO_LEADER, NO_VOTE, PRECANDIDATE, RaftConfig)
+from raftsql_tpu.config import (CANDIDATE, FLOOR_HINT_BIAS, FOLLOWER, LEADER,
+                                MSG_NONE, MSG_PREREQ, MSG_PRERESP, MSG_REQ,
+                                MSG_RESP, NO_LEADER, NO_VOTE, PRECANDIDATE,
+                                RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
@@ -302,18 +303,23 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     next_idx = jnp.where(rs_ok, jnp.maximum(next_idx, inbox.a_match + 1),
                          next_idx)
     # On reject, back off to the follower's conflict hint (its log
-    # length), the fast-backoff analog of etcd's rejection hints — but a
-    # hint AT OR BEYOND our send point is a floor-reject resync request
-    # (Phase 4's floor_rej): the follower holds a log that long and can
-    # only verify appends near its tip, so JUMP next_idx up to hint + 1.
-    # A stale/bogus large hint self-corrects: the probe append at the
+    # length), the fast-backoff analog of etcd's rejection hints.  A
+    # floor-reject resync request (Phase 4's floor_rej: the follower can
+    # only verify appends near its tip) arrives EXPLICITLY marked with
+    # FLOOR_HINT_BIAS on the hint; strip the bias and JUMP next_idx up
+    # to hint + 1.  Ordinary hints only ever walk next_idx down — with
+    # the explicit flag, a late in-flight ordinary reject (whose hint a
+    # previous reject already walked below) can no longer be mistaken
+    # for a resync and re-probe ground the leader already ruled out.  A
+    # stale/bogus biased hint self-corrects: the probe append at the
     # jumped prev is itself verified (or floor-rejected with an honest
     # hint) by the follower.
-    walked = jnp.clip(jnp.minimum(next_idx - 1, inbox.a_match + 1), 1,
-                      None)
+    is_floor_hint = inbox.a_match >= FLOOR_HINT_BIAS
+    hint = inbox.a_match - jnp.where(is_floor_hint, FLOOR_HINT_BIAS, 0)
+    walked = jnp.clip(jnp.minimum(next_idx - 1, hint + 1), 1, None)
     next_idx = jnp.where(
         rs_fail,
-        jnp.where(inbox.a_match >= next_idx, inbox.a_match + 1, walked),
+        jnp.where(is_floor_hint, hint + 1, walked),
         next_idx)
     next_idx = jnp.maximum(next_idx, match + 1)
 
@@ -459,11 +465,14 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # without this, a leader serving below a restarted follower's floor
     # walks next_idx to 1 and the pair livelocks on rejects.
     floor_rej = chosen_mask & ~accept[:, None] & (prev < floor0)[:, None]
-    hint = jnp.clip(jnp.minimum(prev - 1, follower_len0), 0, None)
-    resp_match = jnp.where(succ, app_end[:, None],
-                           jnp.where(floor_rej, follower_len0[:, None],
-                                     jnp.where(chosen_mask, hint[:, None],
-                                               0)))
+    rej_hint = jnp.clip(jnp.minimum(prev - 1, follower_len0), 0, None)
+    # Floor rejects carry the follower's full log length PLUS the
+    # explicit FLOOR_HINT_BIAS marker (see Phase 5 / config.py): the
+    # leader must resync UP to this tip, not walk down.
+    resp_match = jnp.where(
+        succ, app_end[:, None],
+        jnp.where(floor_rej, follower_len0[:, None] + FLOOR_HINT_BIAS,
+                  jnp.where(chosen_mask, rej_hint[:, None], 0)))
 
     # Leader append broadcast: to every peer with pending entries, plus
     # everyone on heartbeat.

@@ -36,21 +36,43 @@ from typing import Callable, Dict, Optional, Tuple
 
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
+from raftsql_tpu.runtime.envelope import unwrap
 from raftsql_tpu.runtime.node import CLOSED
 from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
 
-def _expand_commit_item(item):
+def _expand_commit_item(item, node=None):
     """Normalize a commit_q item to per-entry (group, index, sql) tuples.
 
-    The live publish phase enqueues per-GROUP batches
-    (group, [(index, sql), ...]) so the tick thread pays one queue put
-    per group; WAL replay enqueues per-entry 3-tuples (the nil-sentinel
-    counting protocol must stay item-accurate there)."""
+    Three forms:
+      - (group, base_idx, [raw_bytes, ...]) — the live publish phase's
+        RAW batch (entries at base_idx+1..): one queue put per group per
+        tick, with the per-entry envelope unwrap / dedup / utf-8 decode
+        done HERE, on the consumer thread, off the tick's critical path
+        (`node` supplies the per-group DedupWindow — forward-retried
+        duplicates apply exactly once);
+      - (group, index, sql_str) — WAL replay per-entry items (the
+        nil-sentinel counting protocol must stay item-accurate there);
+      - (group, [(index, sql), ...]) — decoded per-group batches (older
+        producers/tests).
+    """
     if len(item) == 2:
         g = item[0]
         return [(g, i, s) for (i, s) in item[1]]
+    if type(item[2]) is list:
+        g, base, datas = item
+        dedup = node._dedup[g] if node is not None else None
+        out = []
+        for off, data in enumerate(datas):
+            if not data:
+                continue                    # no-op/conf entry
+            pid, payload = unwrap(data)
+            if pid is not None and dedup is not None \
+                    and dedup.seen(pid, base + 1 + off):
+                continue                    # forward-retry duplicate
+            out.append((g, base + 1 + off, payload.decode("utf-8")))
+        return out
     return [item]
 
 
@@ -192,10 +214,11 @@ class RaftDB:
             # must stay strictly item-at-a-time — draining could swallow
             # live entries beyond the nil sentinel it returns at.
             # Items arrive per-entry (group, index, sql) from replay, or
-            # as per-group batches (group, [(index, sql), ...]) from the
-            # live publish phase (runtime/node.py) — expanded HERE so
-            # the tick thread pays one queue put per group.
-            run = _expand_commit_item(item)
+            # as per-group RAW batches (group, base_idx, [bytes, ...])
+            # from the live publish phase (runtime/node.py) — expanded
+            # (unwrap/dedup/decode) HERE so the tick thread pays one
+            # queue put per group and none of the per-entry Python.
+            run = _expand_commit_item(item, self.pipe.node)
             stop = False
             if not replay:
                 while len(run) < 256:
@@ -214,7 +237,7 @@ class RaftDB:
                     if nxt is CLOSED:
                         stop = True
                         break
-                    run.extend(_expand_commit_item(nxt))
+                    run.extend(_expand_commit_item(nxt, self.pipe.node))
             if run:
                 self._apply_run(run)
             if stop:

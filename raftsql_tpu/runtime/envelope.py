@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import secrets
 import struct
+import threading
 from collections import deque
 from typing import Optional, Tuple
 
@@ -53,33 +54,42 @@ class DedupWindow:
     ship exactly the ids applied at or below A — shipping the live window
     (which may run ahead of the state machine's applied point) would make
     the receiver skip entries whose effects its installed state does not
-    contain (runtime/node.py InstallSnapshot path)."""
+    contain (runtime/node.py InstallSnapshot path).
+
+    Thread-safe: `seen` advances on the commit CONSUMER thread (the
+    publish phase ships raw entries; unwrap/dedup runs off the tick
+    thread — runtime/db.py), while `pairs_upto` (snapshot send) and
+    `restore` (snapshot install) run on the tick thread."""
 
     def __init__(self, cap: int = 4096):
         self._cap = cap
         self._fifo: deque = deque()          # (idx, pid), idx ascending
         self._set: set = set()
+        self._mu = threading.Lock()
 
     def seen(self, pid: int, idx: int = 0) -> bool:
         """Check-and-insert; True if pid was already applied recently."""
-        if pid in self._set:
-            return True
-        self._set.add(pid)
-        self._fifo.append((idx, pid))
-        if len(self._fifo) > self._cap:
-            self._set.discard(self._fifo.popleft()[1])
-        return False
+        with self._mu:
+            if pid in self._set:
+                return True
+            self._set.add(pid)
+            self._fifo.append((idx, pid))
+            if len(self._fifo) > self._cap:
+                self._set.discard(self._fifo.popleft()[1])
+            return False
 
     def pairs_upto(self, idx: int) -> list:
         """(idx, pid) pairs applied at or below `idx`, FIFO order."""
-        return [(i, p) for (i, p) in self._fifo if i <= idx]
+        with self._mu:
+            return [(i, p) for (i, p) in self._fifo if i <= idx]
 
     def restore(self, pairs) -> None:
         """Replace the window contents (InstallSnapshot receiver side)."""
-        self._fifo = deque(pairs)
-        self._set = {p for (_, p) in self._fifo}
-        while len(self._fifo) > self._cap:
-            self._set.discard(self._fifo.popleft()[1])
+        with self._mu:
+            self._fifo = deque(pairs)
+            self._set = {p for (_, p) in self._fifo}
+            while len(self._fifo) > self._cap:
+                self._set.discard(self._fifo.popleft()[1])
 
 
 # Snapshot-blob framing: the node wraps the state machine's opaque blob

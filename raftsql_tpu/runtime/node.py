@@ -91,9 +91,18 @@ class RaftNode:
         # messages scatter straight into these [G, P] arrays; record
         # staging (payload appends, and peers speaking the record form)
         # overlays them at inbox-build time.  _stg_a_seq carries the
-        # ReadIndex round binding for BOTH forms.
+        # ReadIndex round binding (REQ rows only — a response's seq lives
+        # in its sender's numberspace and must never be echoed back).
+        # Arrival stamps decide overlay order for mixed delivery forms:
+        # each _deliver bumps _arrival once; _stg_a_arr[g, p] is the stamp
+        # of the newest COLUMNAR append in the slot, _stage_app_arr the
+        # stamp of the staged record — inbox build lets the newer one win,
+        # whatever its form ("newest message per (group, src, slot) wins").
         self._stg: Dict[str, np.ndarray] = self._fresh_stage_cols()
         self._stg_a_seq = np.zeros((G, num_nodes), np.int64)
+        self._stg_a_arr = np.zeros((G, num_nodes), np.int64)
+        self._stage_app_arr: Dict[Tuple[int, int], int] = {}
+        self._arrival = 0
 
         # InstallSnapshot hooks (wired by the apply layer in resume mode;
         # both unset => full state transfer disabled, catch-up below the
@@ -111,6 +120,15 @@ class RaftNode:
 
         self._prop_lock = threading.Lock()
         self._props: List[deque] = [deque() for _ in range(G)]
+        # Incremental O(active) bookkeeping for the two per-tick walks
+        # that profiled O(G) at G=10k (VERDICT r3 task 4): _prop_len[g]
+        # mirrors len(_props[g]) so the tick's prop_n build is one
+        # vectorized minimum instead of a 10k-deque generator; _fwd_groups
+        # is the set of groups with queued or in-flight-forwarded
+        # proposals, so the forwarding walk touches only those.  Both are
+        # guarded by _prop_lock, same as the structures they mirror.
+        self._prop_len = np.zeros(G, np.int32)
+        self._fwd_groups: set = set()
         # Proposals forwarded to a (possibly stale) leader hint, kept as
         # (payload, deadline_tick): if the payload is not observed
         # committed by the deadline, it is re-queued and forwarded again.
@@ -244,6 +262,8 @@ class RaftNode:
                              f"[0, {self.cfg.num_groups})")
         with self._prop_lock:
             self._props[group].append(wrap(payload))
+            self._prop_len[group] += 1
+            self._fwd_groups.add(group)
 
     def propose_many(self, group: int, payloads) -> None:
         """Batch `propose`: one lock hold and envelope pass for a whole
@@ -255,6 +275,8 @@ class RaftNode:
         wrapped = [wrap(p) for p in payloads]
         with self._prop_lock:
             self._props[group].extend(wrapped)
+            self._prop_len[group] += len(wrapped)
+            self._fwd_groups.add(group)
 
     def _decode_entry(self, group: int, data: bytes,
                       idx: int = 0) -> Optional[str]:
@@ -409,8 +431,17 @@ class RaftNode:
             s["a_commit"][g, src0] = c.a_commit[m]
             s["a_success"][g, src0] = c.a_success[m]
             s["a_match"][g, src0] = c.a_match[m]
+            self._stg_a_arr[g, src0] = self._arrival
             seq = c.a_seq[m]
-            self._stg_a_seq[g, src0] = seq
+            # Seq is the ReadIndex round binding: only REQ rows may set
+            # it (we echo the seq of the request we answer).  A response
+            # row's seq is the SENDER's tick number — writing it here
+            # last-writer-wins could inflate the echo past rounds the
+            # peer ever sent, letting read_ready() confirm a ReadIndex
+            # with no real quorum round (stale linearizable read).
+            req = c.a_type[m] == MSG_REQ
+            if req.any():
+                self._stg_a_seq[g[req], src0] = seq[req]
             # ReadIndex round bookkeeping for columnar responses.
             rm = (c.a_type[m] == MSG_RESP) & (seq > 0)
             if rm.any():
@@ -435,6 +466,8 @@ class RaftNode:
                         self.node_id, src)
             return
         with self._stage_lock:
+            self._arrival += 1
+            arrival = self._arrival
             if batch.cols is not None:
                 self._stage_cols(src0, batch.cols)
             for v in batch.votes:
@@ -444,7 +477,7 @@ class RaftNode:
                 if 0 <= a.group < G and a.n <= E \
                         and len(a.payloads) in (0, a.n):
                     self._stage_apps[(a.group, src0)] = a
-                    self._stg_a_seq[a.group, src0] = a.seq
+                    self._stage_app_arr[(a.group, src0)] = arrival
                     if a.type == MSG_RESP and a.seq:
                         # ReadIndex round bookkeeping: newest request-seq
                         # this peer has answered, and at what term.
@@ -461,6 +494,8 @@ class RaftNode:
                 for pr in batch.proposals:
                     if 0 <= pr.group < G:
                         self._props[pr.group].append(pr.payload)
+                        self._prop_len[pr.group] += 1
+                        self._fwd_groups.add(pr.group)
 
     # ------------------------------------------------------------------
     # the event loop
@@ -499,8 +534,7 @@ class RaftNode:
         self._tick_apps = tick_apps
 
         with self._prop_lock:
-            prop_n = np.fromiter(
-                (min(len(q), E) for q in self._props), np.int32, G)
+            prop_n = np.minimum(self._prop_len, E)
         t0 = time.monotonic()
         m.t_stage_ms += (t0 - ts) * 1e3
 
@@ -611,6 +645,8 @@ class RaftNode:
                 with self._prop_lock:
                     self._props[g].extendleft(
                         reversed([d for (_, d) in self._local[g]]))
+                    self._prop_len[g] += len(self._local[g])
+                    self._fwd_groups.add(g)
                 self._local[g] = []
             log.info("node %d g%d: installed snapshot at idx %d",
                      self.node_id, g, rec.last_idx)
@@ -622,14 +658,18 @@ class RaftNode:
         a_ents = np.zeros((G, P, E), np.int32)
         with self._stage_lock:
             votes, apps = self._stage_votes, self._stage_apps
+            app_arr = self._stage_app_arr
             self._stage_votes, self._stage_apps = {}, {}
+            self._stage_app_arr = {}
             # Columnar staging becomes the inbox base (no copy — fresh
             # arrays replace them for the next window); the record dicts
             # overlay it below.  Columnar appends are always n == 0.
             stg = self._stg
             seq_arr = self._stg_a_seq
+            col_arr = self._stg_a_arr
             self._stg = self._fresh_stage_cols()
             self._stg_a_seq = np.zeros_like(seq_arr)
+            self._stg_a_arr = np.zeros_like(col_arr)
         v_type, v_term = stg["v_type"], stg["v_term"]
         v_li, v_lt, v_gr = stg["v_last_idx"], stg["v_last_term"], \
             stg["v_granted"].astype(bool)
@@ -641,12 +681,27 @@ class RaftNode:
             v_type[g, s], v_term[g, s] = v.type, v.term
             v_li[g, s], v_lt[g, s] = v.last_idx, v.last_term
             v_gr[g, s] = v.granted
+        stale: List[Tuple[int, int]] = []
         for (g, s), a in apps.items():
+            if app_arr.get((g, s), 0) < col_arr[g, s]:
+                # A columnar message for this slot arrived AFTER the
+                # record was staged: the newer arrival wins, whatever its
+                # form.  (An older record REQ displacing a newer columnar
+                # response would also mis-bind the seq echo below.)
+                stale.append((g, s))
+                continue
             a_type[g, s], a_term[g, s] = a.type, a.term
             a_pi[g, s], a_pt[g, s] = a.prev_idx, a.prev_term
             a_n[g, s], a_cm[g, s] = a.n, a.commit
             a_su[g, s], a_ma[g, s] = a.success, a.match
             a_ents[g, s, :a.n] = a.ent_terms[:E]
+            if a.type == MSG_REQ:
+                # Bind the seq echo to the request the device will
+                # actually process (the record overlays the columnar
+                # base, so its seq must overlay too).
+                seq_arr[g, s] = a.seq
+        for k in stale:
+            del apps[k]
         inbox = Inbox(
             v_type=jnp.asarray(v_type), v_term=jnp.asarray(v_term),
             v_last_idx=jnp.asarray(v_li), v_last_term=jnp.asarray(v_lt),
@@ -685,6 +740,18 @@ class RaftNode:
             w_data.append(data)
 
         active = np.nonzero(noop | (prop_acc > 0) | (app_from >= 0))[0]
+        # ONE lock hold pops every group's accepted proposals (a per-group
+        # acquire inside the loop was ~256 lock round trips per saturated
+        # tick at the G=10k/256-active bench shape).
+        acc = np.nonzero(prop_acc > 0)[0]
+        popped: Dict[int, List[bytes]] = {}
+        if acc.size:
+            with self._prop_lock:
+                for g in acc.tolist():
+                    n = int(prop_acc[g])
+                    q = self._props[g]
+                    popped[g] = [q.popleft() for _ in range(n)]
+                    self._prop_len[g] -= n
         for g in active.tolist():
             n_acc = int(prop_acc[g])
             if noop[g] or n_acc:
@@ -694,9 +761,7 @@ class RaftNode:
                     put_rec(g, base, t_g, b"")
                     self.payload_log.put(g, base, [b""], [t_g])
                 if n_acc:
-                    with self._prop_lock:
-                        batch = [self._props[g].popleft()
-                                 for _ in range(n_acc)]
+                    batch = popped[g]
                     # Batched list extends: per-record put_rec calls
                     # were ~20% of this phase at saturation.
                     w_groups.extend([g] * n_acc)
@@ -731,6 +796,8 @@ class RaftNode:
                     if requeue:
                         with self._prop_lock:
                             self._props[g].extendleft(reversed(requeue))
+                            self._prop_len[g] += len(requeue)
+                            self._fwd_groups.add(g)
                     self._local[g] = [(ix, d) for (ix, d) in mine
                                       if ix < start]
                 if info.app_conflict[g] and self._applied[g] >= start:
@@ -1002,22 +1069,32 @@ class RaftNode:
         hint = info.leader_hint
         deadline = self._tick_no + 4 * cfg.election_ticks
         with self._prop_lock:
-            for g in range(cfg.num_groups):
-                expired = [p for (p, d) in self._fwd[g]
-                           if d <= self._tick_no]
-                if expired:
-                    self._fwd[g] = [(p, d) for (p, d) in self._fwd[g]
-                                    if d > self._tick_no]
-                    self._props[g].extendleft(reversed(expired))
+            # O(dirty), not O(G): only groups with queued or in-flight
+            # forwarded proposals are walked — at G=10k the full-range
+            # walk was most of this phase's Python even with every
+            # queue empty.
+            for g in list(self._fwd_groups):
+                fwd_g = self._fwd[g]
+                if fwd_g:
+                    expired = [p for (p, d) in fwd_g
+                               if d <= self._tick_no]
+                    if expired:
+                        self._fwd[g] = [(p, d) for (p, d) in fwd_g
+                                        if d > self._tick_no]
+                        self._props[g].extendleft(reversed(expired))
+                        self._prop_len[g] += len(expired)
                 h = int(hint[g])
                 if role[g] != LEADER and h >= 0 and h != self.self_id \
                         and self._props[g]:
                     fwd = list(self._props[g])
                     self._props[g].clear()
+                    self._prop_len[g] = 0
                     for p in fwd:
                         batch_for(h).proposals.append(
                             ProposalRec(group=g, payload=p))
                         self._fwd[g].append((p, deadline))
+                elif not self._props[g] and not self._fwd[g]:
+                    self._fwd_groups.discard(g)
 
         for dst0, batch in batches.items():
             self.transport.send(dst0 + 1, batch)
@@ -1050,35 +1127,24 @@ class RaftNode:
                 raise RuntimeError(
                     f"g{g}: payload log shorter than commit "
                     f"({a}+{len(datas)} < {c})")
-            items = []
-            # Hoisted per-group lookups: every entry is enveloped (wrap()
-            # at propose time gives forward-retry dedup its ids), so the
-            # per-entry cost is the unwrap + dedup chain itself — inline
-            # it rather than paying a _decode_entry call per entry
-            # (~4 µs each, half this phase at saturation).
-            dedup_seen = self._dedup[g].seen
-            for off, data in enumerate(datas):
-                idx = a + 1 + off
-                if not data:
-                    continue
-                if fwd:
-                    # Forwarded proposal observed committed: retire it
-                    # (exact match — envelope ids are unique).
+            if fwd:
+                # Forwarded proposal observed committed: retire it
+                # (exact match — envelope ids are unique).  Tick-thread
+                # only (_fwd has no lock); almost always empty — only
+                # follower-routed proposals enter it.
+                for data in datas:
                     for k, (p, _) in enumerate(fwd):
                         if p == data:
                             del fwd[k]
                             break
-                pid, payload = unwrap(data)
-                if pid is not None and dedup_seen(pid, idx):
-                    continue
-                items.append((idx, payload.decode("utf-8")))
-            if items:
-                # One queue put per group per tick (batch form
-                # (g, [(idx, sql), ...]); pipe.commit_q contract): at
-                # saturation the per-ENTRY puts were half this phase,
-                # paid on the tick thread — the consumer expands the
-                # batch on ITS thread (runtime/db.py _read_commits).
-                self.commit_q.put((g, items))
+            if any(datas):
+                # RAW batch, one queue put per group per tick: the
+                # per-entry unwrap/dedup/utf-8 chain (~2.5 µs each, the
+                # bulk of this phase at saturation) now runs on the
+                # CONSUMER thread (runtime/db.py _expand_commit_item),
+                # off the tick's critical path.  All-empty ranges
+                # (no-op/conf entries) publish nothing, as before.
+                self.commit_q.put((g, a, datas))
             self._applied[g] = c
             self.metrics.commits += c - a
             if self._local[g]:

@@ -20,11 +20,13 @@ from raftsql_tpu.runtime.node import RaftNode
 class RaftPipe:
     def __init__(self, node: RaftNode):
         self.node = node
-        # Items: (group, index, sql) per-entry (replay), or the batch
-        # form (group, [(index, sql), ...]) from the live publish phase
-        # (one put per group per tick); None = replay-done sentinel,
-        # CLOSED = stream end.  Consumers normalize via
-        # runtime.db._expand_commit_item.
+        # Items: (group, index, sql) per-entry (replay), or the RAW
+        # batch form (group, base_idx, [bytes, ...]) from the live
+        # publish phase (one put per group per tick; entries still
+        # enveloped — unwrap/dedup/decode happens on the CONSUMER
+        # thread); None = replay-done sentinel, CLOSED = stream end.
+        # Consumers normalize via runtime.db._expand_commit_item(item,
+        # node).
         self.commit_q = node.commit_q
 
     @classmethod

@@ -159,17 +159,23 @@ class PayloadLog:
         with self._mu:
             log = self._logs[group]
             off = self._start[group]
-            for i, (term, data) in enumerate(zip(terms, payloads)):
-                pos = start - 1 + i - off
-                if pos < 0:
-                    continue    # below the compaction floor: immutable
-                if pos < len(log):
-                    log[pos] = (term, data)
-                elif pos == len(log):
-                    log.append((term, data))
-                else:
-                    raise ValueError(
-                        f"payload gap: group {group} idx {pos + 1 + off} "
-                        f"> len {len(log) + off}")
+            if start - 1 - off == len(log):
+                # Pure tail append — the leader/follower hot path (the
+                # per-entry positioned loop below was the single largest
+                # Python cost of the durable WAL phase at saturation).
+                log.extend(zip(terms, payloads))
+            else:
+                for i, (term, data) in enumerate(zip(terms, payloads)):
+                    pos = start - 1 + i - off
+                    if pos < 0:
+                        continue   # below the compaction floor: immutable
+                    if pos < len(log):
+                        log[pos] = (term, data)
+                    elif pos == len(log):
+                        log.append((term, data))
+                    else:
+                        raise ValueError(
+                            f"payload gap: group {group} idx "
+                            f"{pos + 1 + off} > len {len(log) + off}")
             if new_len is not None and new_len - off < len(log):
                 del log[max(new_len - off, 0):]

@@ -240,25 +240,44 @@ class WAL:
         self._write(_ENTRY.pack(REC_ENTRY, group, index, term) + data)
 
     def append_entries(self, groups, indexes, terms, datas) -> None:
-        """Batched append — one native call for a whole tick's records."""
+        """Batched append — one native call for a whole tick's records.
+
+        Contract: within each group, `indexes` arrive ascending (the
+        tick's WAL phase emits per-group ranges) — the stats pass below
+        exploits it (last write per group is its max index)."""
         if self._lib is None:
             for g, i, t, d in zip(groups, indexes, terms, datas):
                 self.append_entry(g, i, t, d)
             return
         import ctypes
+
+        import numpy as np
         n = len(groups)
         if n == 0:
             return
+        # One dict store per record (ascending-per-group makes last wins
+        # == max); the per-record bump() get+compare was ~10% of the
+        # saturated WAL phase.
+        last: Dict[int, int] = {}
         for g, i in zip(groups, indexes):
-            self._active_stats.bump(g, i)
+            last[g] = i
+        bump = self._active_stats.bump
+        for g, i in last.items():
+            bump(g, i)
         blob = b"".join(datas)
+        # numpy list→array conversion marshals the parallel arrays ~5x
+        # faster than ctypes (c_uint32 * n)(*list) star-unpacking.
+        ga = np.asarray(groups, np.uint32)
+        ia = np.asarray(indexes, np.uint64)
+        ta = np.asarray(terms, np.uint64)
+        la = np.fromiter((len(d) for d in datas), np.uint32, n)
         self._lib.wal_append_entries(
             self._h, n,
-            (ctypes.c_uint32 * n)(*groups),
-            (ctypes.c_uint64 * n)(*indexes),
-            (ctypes.c_uint64 * n)(*terms),
+            ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ia.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ta.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             blob,
-            (ctypes.c_uint32 * n)(*[len(d) for d in datas]))
+            la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
         self._pending = True
         self._bytes += n * (_HDR.size + _ENTRY.size) + len(blob)
 

@@ -147,11 +147,23 @@ def decode_batch(blob: bytes) -> TickBatch:
     if off < len(blob):
         cols = ColRecs()
         (nv_,) = take(_U32)
+        # Bound-check declared counts against the remaining bytes BEFORE
+        # any frombuffer: a truncated or corrupt frame must surface as a
+        # codec-level decode error (struct.error, matching the record
+        # sections above), not a ValueError deep inside numpy.
+        if nv_ * 4 * len(_COL_V) > len(blob) - off:
+            raise struct.error(
+                f"columnar vote section truncated: {nv_} rows declared, "
+                f"{len(blob) - off} bytes remain")
         for f in _COL_V:
             arr = np.frombuffer(blob, np.dtype("<i4"), nv_, off)
             setattr(cols, f, arr)
             off += 4 * nv_
         (na_,) = take(_U32)
+        if na_ * (4 * (len(_COL_A) - 1) + 8) > len(blob) - off:
+            raise struct.error(
+                f"columnar append section truncated: {na_} rows declared, "
+                f"{len(blob) - off} bytes remain")
         for f in _COL_A:
             dt = np.dtype("<i8") if f == "a_seq" else np.dtype("<i4")
             arr = np.frombuffer(blob, dt, na_, off)

@@ -359,7 +359,7 @@ class TestFloorResync:
     cannot reach)."""
 
     def test_floor_reject_hints_full_log_len(self):
-        from raftsql_tpu.config import MSG_REQ, MSG_RESP
+        from raftsql_tpu.config import FLOOR_HINT_BIAS, MSG_REQ, MSG_RESP
         from raftsql_tpu.core.state import (empty_inbox,
                                             install_snapshot_state,
                                             init_peer_state)
@@ -381,11 +381,11 @@ class TestFloorResync:
         assert int(info.app_from[0]) == -1, "below-floor hb accepted"
         assert int(out.a_type[0, 0]) == MSG_RESP
         assert not bool(out.a_success[0, 0])
-        assert int(out.a_match[0, 0]) == 57, \
-            "floor reject must hint the full log length"
+        assert int(out.a_match[0, 0]) == 57 + FLOOR_HINT_BIAS, \
+            "floor reject must hint the full log length, explicitly marked"
 
     def test_leader_jumps_next_idx_on_resync_hint(self):
-        from raftsql_tpu.config import LEADER, MSG_RESP
+        from raftsql_tpu.config import FLOOR_HINT_BIAS, LEADER, MSG_RESP
         from raftsql_tpu.core.state import empty_inbox, init_peer_state
         from raftsql_tpu.core.step import peer_step
 
@@ -402,8 +402,43 @@ class TestFloorResync:
             next_idx=st.next_idx.at[0].set(
                 jnp.asarray([61, 1, 61], jnp.int32)))
         ib = empty_inbox(cfg)
-        # Follower 1's floor-reject of our prev=0 probe: hint 57 >= our
-        # next_idx 1 -> resync jump to 58 (not a walk to 1).
+        # Follower 1's floor-reject of our prev=0 probe: explicitly
+        # marked hint 57 -> resync jump to 58 (not a walk to 1).
+        ib = ib._replace(
+            a_type=ib.a_type.at[0, 1].set(MSG_RESP),
+            a_term=ib.a_term.at[0, 1].set(2),
+            a_success=ib.a_success.at[0, 1].set(False),
+            a_match=ib.a_match.at[0, 1].set(57 + FLOOR_HINT_BIAS))
+        st2, out, info = peer_step(cfg, st, ib,
+                                   jnp.zeros((1,), jnp.int32),
+                                   jnp.asarray(0, jnp.int32))
+        assert int(st2.next_idx[0, 1]) == 58, int(st2.next_idx[0, 1])
+
+    def test_stale_ordinary_reject_never_jumps_up(self):
+        """A late in-flight ORDINARY reject whose hint sits at/above the
+        (already walked-down) next_idx must not re-raise it: only the
+        explicit floor marker may steer next_idx up.  Before the marker,
+        hint >= next_idx was inferred as a resync request, so a stale
+        conflict hint re-probed ground the leader had ruled out."""
+        from raftsql_tpu.config import LEADER, MSG_RESP
+        from raftsql_tpu.core.state import empty_inbox, init_peer_state
+        from raftsql_tpu.core.step import peer_step
+
+        cfg = small_cfg(num_groups=1, log_window=16, max_entries_per_msg=4)
+        st = init_peer_state(cfg, 0)
+        st = st._replace(
+            term=st.term.at[0].set(2),
+            role=st.role.at[0].set(LEADER),
+            log_len=st.log_len.at[0].set(60),
+            commit=st.commit.at[0].set(60),
+            tbl_pos=st.tbl_pos.at[0, -1].set(1),
+            tbl_term=st.tbl_term.at[0, -1].set(2),
+            match=st.match.at[0].set(jnp.asarray([60, 0, 0], jnp.int32)),
+            next_idx=st.next_idx.at[0].set(
+                jnp.asarray([61, 2, 61], jnp.int32)))
+        ib = empty_inbox(cfg)
+        # Unbiased conflict hint 57 >= next_idx 2: walk (to
+        # min(next_idx-1, hint+1) = 1), never jump to 58.
         ib = ib._replace(
             a_type=ib.a_type.at[0, 1].set(MSG_RESP),
             a_term=ib.a_term.at[0, 1].set(2),
@@ -412,4 +447,4 @@ class TestFloorResync:
         st2, out, info = peer_step(cfg, st, ib,
                                    jnp.zeros((1,), jnp.int32),
                                    jnp.asarray(0, jnp.int32))
-        assert int(st2.next_idx[0, 1]) == 58, int(st2.next_idx[0, 1])
+        assert int(st2.next_idx[0, 1]) == 1, int(st2.next_idx[0, 1])

@@ -296,6 +296,38 @@ class TestCodec:
         with pytest.raises(AssertionError):
             encode_batch(bad)
 
+    def test_truncated_columnar_section_is_codec_error(self):
+        """A truncated/corrupt trailing ColSection must fail as a codec
+        error (struct.error, like the record sections), not a ValueError
+        deep inside numpy frombuffer."""
+        import struct
+
+        import numpy as np
+
+        from raftsql_tpu.transport.base import ColRecs
+
+        c = ColRecs()
+        c.a_group = np.arange(4, dtype=np.int32)
+        c.a_type = np.full(4, MSG_RESP, np.int32)
+        c.a_term = np.ones(4, np.int32)
+        c.a_prev_idx = np.zeros(4, np.int32)
+        c.a_prev_term = np.zeros(4, np.int32)
+        c.a_commit = np.zeros(4, np.int32)
+        c.a_success = np.ones(4, np.int32)
+        c.a_match = np.arange(4, dtype=np.int32)
+        c.a_seq = np.arange(4, dtype=np.int64)
+        blob = encode_batch(TickBatch(cols=c))
+        # Drop tail bytes at several depths: mid-a_seq, mid-columns, and
+        # right after the declared count.
+        for cut in (8, len(blob) // 2, len(blob) - 4):
+            with pytest.raises(struct.error):
+                decode_batch(blob[:len(blob) - cut])
+        # Corrupt count: a huge declared na over an empty remainder.
+        head = encode_batch(TickBatch())
+        with pytest.raises(struct.error):
+            decode_batch(head + struct.pack("<I", 0)
+                         + struct.pack("<I", 1 << 28))
+
 
 class TestEnvelope:
     def test_wrap_unwrap(self):
