@@ -62,9 +62,58 @@ import time
 
 NORTH_STAR_COMMITS_PER_SEC = 1.0e8
 
+# Committed ledger of every successful on-device measurement (VERDICT r3
+# task 1): the round-3 TPU evidence survived only in a gitignored stray
+# stderr log while the official JSON recorded a CPU fallback, because
+# the tunnel wedged between the real run and the driver's capture.
+# Every TPU child now appends its JSON line (+ timestamp, git SHA,
+# shape) here, and the parent's CPU-fallback JSON carries the newest
+# ledger entry as `last_good_tpu` — the headline stays honest (CPU),
+# but the history stops being erasable.
+TPU_RUNS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "TPU_RUNS.jsonl")
+
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _git_sha() -> str:
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                           text=True, timeout=10)
+        return r.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _ledger_append(record: dict) -> None:
+    """Append one run record to TPU_RUNS.jsonl (best-effort: a read-only
+    checkout must not fail the measurement that produced the record)."""
+    try:
+        with open(TPU_RUNS_PATH, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError as e:                       # pragma: no cover
+        _log(f"bench: ledger append failed: {e}")
+
+
+def _ledger_last_good() -> dict | None:
+    """Newest TPU entry from the committed ledger, or None."""
+    try:
+        with open(TPU_RUNS_PATH) as f:
+            lines = f.read().strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("platform") == "tpu":
+            return rec
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +513,10 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
         sms = [KVStateMachine() for _ in range(groups)]
         mk_cmd = "SET k v"
 
-    def drain(n0: "RaftNode", apply: bool) -> int:
+    def drain(n0: "RaftNode", apply: bool, t0q=None, lats=None) -> int:
+        """Consume node 0's commit stream; apply; record wall-clock
+        propose→apply latency by matching each group's applies (commit
+        order) against its FIFO of propose timestamps (t0q)."""
         cnt = 0
         per_g: dict = {}
         while True:
@@ -475,7 +527,7 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
             if item is None or not isinstance(item, tuple):
                 continue
             from raftsql_tpu.runtime.db import _expand_commit_item
-            for g, idx, cmd in _expand_commit_item(item):
+            for g, idx, cmd in _expand_commit_item(item, n0):
                 if apply:
                     per_g.setdefault(g, []).append((cmd, idx))
                 cnt += 1
@@ -488,6 +540,12 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
             bad = [e for e in errs if e is not None]
             if bad:     # a commits/s number for failed applies is a lie
                 raise RuntimeError(f"apply failed in group {g}: {bad[0]}")
+        if t0q is not None and per_g:
+            now = time.perf_counter()
+            for g, items in per_g.items():
+                q = t0q[g]
+                for _ in range(min(len(items), len(q))):
+                    lats.append(now - q.popleft())
         return cnt
 
     try:
@@ -549,8 +607,62 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
                  f"phase_ms={m['phase_ms_per_tick']}")
             best = max(best, rate)
         phase = nodes[0].metrics.snapshot()["phase_ms_per_tick"]
+
+        # -- Latency phase (VERDICT r3 task 3): REAL wall-clock
+        # propose→commit+apply per proposal, measured end to end on the
+        # durable stack.  Load arrives at the service rate (E per group
+        # per tick, the flow-control ceiling) instead of pre-queued, so
+        # the number is pipeline latency, not backlog drain; the feeder
+        # is the client and its cost is honestly on the clock.  The
+        # active set is bounded so feeding doesn't dominate the tick.
+        from collections import deque as _deque
+        lat_active = min(active, int(os.environ.get(
+            "BENCH_DURABLE_LAT_ACTIVE", "256")))
+        lat_ticks = max(ticks, 16)
+        t0q = [_deque() for _ in range(groups)]
+        lats: list = []
+        # Flush the throughput phase's in-flight pipeline tail BEFORE
+        # arming timestamps: leftover commits would otherwise be matched
+        # FIFO against the new t0s, shifting every sample early by the
+        # pipeline depth.
+        for _ in range(8):
+            for n in nodes:
+                n.tick()
+            if drain(nodes[0], apply=True) == 0:
+                break
+        for t in range(lat_ticks):
+            now = time.perf_counter()
+            if sm_kind == "sqlite":
+                cmds = [mk_cmd.encode()] * E
+            else:
+                cmds = [f"SET lat{t}_{i} v".encode() for i in range(E)]
+            for g in range(lat_active):
+                h = int(hints[g])
+                nodes[h if h >= 0 else 0].propose_many(g, cmds)
+                t0q[g].extend([now] * E)
+            for n in nodes:
+                n.tick()
+            drain(nodes[0], apply=True, t0q=t0q, lats=lats)
+        for _ in range(6):          # resolve the in-flight pipeline tail
+            for n in nodes:
+                n.tick()
+            drain(nodes[0], apply=True, t0q=t0q, lats=lats)
+        censored = sum(len(q) for q in t0q)
+        lat_stats = None
+        if lats:
+            lats.sort()
+            lat_stats = {
+                "p50_ms": round(lats[int(0.5 * (len(lats) - 1))] * 1e3, 3),
+                "p99_ms": round(lats[int(0.99 * (len(lats) - 1))] * 1e3, 3),
+                "n": len(lats), "censored": censored,
+                "active": lat_active, "load_per_tick": E}
+            _log(f"  durable wall-clock latency ({lat_active} active, "
+                 f"{E}/group/tick): p50={lat_stats['p50_ms']} ms "
+                 f"p99={lat_stats['p99_ms']} ms over {len(lats)} acks, "
+                 f"{censored} censored")
         return best, {"durable_phase_ms": phase,
-                      "durable_tick_ms": round(sum(phase.values()), 3)}
+                      "durable_tick_ms": round(sum(phase.values()), 3),
+                      "durable_lat": lat_stats}
     finally:
         for n in nodes:
             try:
@@ -688,18 +800,58 @@ def child_main() -> None:
             "backend": backend,
         }
     out.update(extras)
+    if platform == "tpu":
+        # Durable evidence (VERDICT r3 task 1): a wedged tunnel at the
+        # driver's capture time must never again erase a real TPU run.
+        rec = dict(out)
+        rec.update({
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "git_sha": _git_sha(),
+            "config": config,
+            "groups": os.environ.get("BENCH_GROUPS", ""),
+            "e": os.environ.get("BENCH_E", ""),
+        })
+        _ledger_append(rec)
     print(json.dumps(out))
 
 
 def probe_main() -> None:
     """Tiny child: report the default platform (and that it can compute)."""
+    plan = os.environ.get("BENCH_FAKE_PROBE_PLAN")
+    if plan:
+        # Test hook (tests/test_bench.py): script the probe outcomes to
+        # simulate a wedged-then-recovered tunnel.  Each probe consumes
+        # one comma-separated step ("timeout" hangs until the parent's
+        # timeout kills it; anything else is reported as the platform),
+        # tracked in a state file since probes are separate processes.
+        state = os.environ["BENCH_FAKE_PROBE_STATE"]
+        try:
+            with open(state) as f:
+                i = int(f.read().strip() or "0")
+        except OSError:
+            i = 0
+        with open(state, "w") as f:
+            f.write(str(i + 1))
+        steps = plan.split(",")
+        step = steps[min(i, len(steps) - 1)]
+        if step == "timeout":
+            time.sleep(3600)
+        plat, _, backend = step.partition(":")
+        print(json.dumps({"probe": plat, "backend": backend or plat,
+                          "devices": 1}))
+        return
     import jax
     import jax.numpy as jnp
 
     d = jax.devices()[0]
     jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
     platform = "tpu" if d.platform == "axon" else d.platform
-    print(json.dumps({"probe": platform, "devices": len(jax.devices())}))
+    # The raw backend name ("axon" for the remote-TPU tunnel) lets the
+    # late-recovery ladder pin its children to this exact platform —
+    # pinning "tpu" fails there, and unpinned children hang if the
+    # tunnel wedges again between probe and rung.
+    print(json.dumps({"probe": platform, "backend": d.platform,
+                      "devices": len(jax.devices())}))
 
 
 # ---------------------------------------------------------------------------
@@ -842,6 +994,43 @@ def main() -> None:
             extra_env={"BENCH_CONFIG": "durable"},
             label="durable-cpu")
 
+    # -- 3a. late re-probe (VERDICT r3 task 8): a tunnel that was wedged
+    # during the early probes but recovered mid-budget was never noticed
+    # — round 3 lost its TPU headline to exactly this.  If the ladder
+    # produced nothing and budget remains after the (device-independent)
+    # durable child, probe once more and rerun the rungs smallest-first.
+    if not results and remaining() > fallback_reserve + 60:
+        probe = _attempt("", probe_timeout, label="probe-late", mode="probe")
+        late_platform = (probe or {}).get("probe", "none")
+        _log(f"bench parent: late re-probe platform = {late_platform}")
+        if probe and late_platform not in ("cpu", "none"):
+            platform = late_platform
+            # Pin rung children to the probed RAW backend (e.g. "axon"):
+            # an unpinned child re-resolves the default platform and
+            # hangs all over again if the tunnel re-wedges; the pin also
+            # lets the stubbed-parent test drive this path on cpu.
+            late_backend = (probe or {}).get("backend", "")
+            for G in ladder:
+                if remaining() < fallback_reserve + 60:
+                    faults.setdefault(G, []).append("late:budget-exhausted")
+                    continue
+                got = _attempt(
+                    late_backend,
+                    min(timeout_s, remaining() - fallback_reserve),
+                    extra_env={"BENCH_GROUPS": G, "BENCH_SKIP_SWEEP": "1",
+                               "BENCH_TICKS": os.environ.get(
+                                   "BENCH_TICKS", "400")},
+                    label=f"tpu-G{G}-late")
+                if got and got.get("value", 0) > 0:
+                    results[G] = got
+                else:
+                    faults.setdefault(G, []).append(
+                        "late:" + ("no-json-or-crash" if got is None
+                                   else "zero"))
+            _log(f"bench parent: late ladder results "
+                 f"{ {g: round(r['value'], 1) for g, r in results.items()} }"
+                 f" faults {faults}")
+
     # -- 3b. latency child on the device: ONE small shape (G=1024, E=16)
     # where the 3-tick pipeline meets the <2 ms p50 target; its own
     # child so a fault cannot cost the headline and the ladder rungs
@@ -893,6 +1082,7 @@ def main() -> None:
         if durable:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
+            parsed["durable_lat"] = durable.get("durable_lat")
         _emit(parsed)
         return
 
@@ -910,6 +1100,13 @@ def main() -> None:
         if durable:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
+            parsed["durable_lat"] = durable.get("durable_lat")
+        # Clearly-labeled history, not a headline: the newest committed
+        # TPU_RUNS.jsonl entry, so a wedged tunnel leaves a citable
+        # last-known-good TPU result in the official record.
+        last_good = _ledger_last_good()
+        if last_good:
+            parsed["last_good_tpu"] = last_good
         _emit(parsed)
         return
     _log("bench parent: all attempts failed")

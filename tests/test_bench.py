@@ -46,6 +46,65 @@ def test_durable_child_micro():
     assert set(phases) == {"stage", "device", "wal", "send", "publish"}
 
 
+def test_parent_recovers_tunnel_on_late_reprobe(tmp_path):
+    """VERDICT r3 task 8 (the round-3 failure mode): both early probes
+    hang, but the tunnel recovers mid-budget — the late re-probe must
+    notice and the parent must still produce a ladder headline instead
+    of the CPU fallback."""
+    state = str(tmp_path / "probe_state")
+    r, out = run_bench({
+        "BENCH_FAKE_PROBE_PLAN": "timeout,timeout,tpu:cpu",
+        "BENCH_FAKE_PROBE_STATE": state,
+        # Probe timeout must comfortably cover interpreter startup (~5 s
+        # under load) so the fake-plan branch is reached; the scripted
+        # "timeout" steps sleep 3600 s and still trip it.
+        "BENCH_PROBE_TIMEOUT_S": "30", "BENCH_ATTEMPT_TIMEOUT_S": "120",
+        "BENCH_TOTAL_BUDGET_S": "400", "BENCH_SKIP_DURABLE": "1",
+        "BENCH_SKIP_SWEEP": "1", "BENCH_SKIP_RULES": "1",
+        "BENCH_LADDER": "64", "BENCH_TICKS": "20", "BENCH_REPEATS": "1",
+        "BENCH_E": "8"}, timeout=480)
+    assert r.returncode == 0, r.stderr[-800:]
+    # Ladder headline, not the no-TPU fallback: the late probe reported
+    # a live device, so the rung children ran (on this host's real CPU
+    # backend — only the probe outcome is scripted).
+    assert out["value"] > 0
+    assert out.get("ladder") == {"64": out["value"]}, out
+    assert "tpu_probe" not in out
+    assert "probe-late" in r.stderr
+    # All three probes consumed: two early (timed out) + one late.
+    with open(state) as f:
+        assert f.read().strip() == "3"
+
+
+def test_ledger_append_and_last_good(tmp_path, monkeypatch):
+    """Every successful TPU child appends to TPU_RUNS.jsonl; the
+    CPU-fallback parent surfaces the newest entry as last_good_tpu."""
+    import bench
+
+    path = str(tmp_path / "TPU_RUNS.jsonl")
+    monkeypatch.setattr(bench, "TPU_RUNS_PATH", path)
+    assert bench._ledger_last_good() is None          # missing file
+    bench._ledger_append({"platform": "cpu", "value": 1.0})
+    assert bench._ledger_last_good() is None          # no TPU entries
+    bench._ledger_append({"platform": "tpu", "value": 2.0, "ts": "t1"})
+    bench._ledger_append({"platform": "tpu", "value": 3.0, "ts": "t2"})
+    with open(path, "a") as f:
+        f.write("not json\n")                         # corruption tolerated
+    got = bench._ledger_last_good()
+    assert got == {"platform": "tpu", "value": 3.0, "ts": "t2"}
+
+
+def test_committed_ledger_has_r3_tpu_evidence():
+    """The round-3 TPU ladder evidence must stay committed and parseable
+    (VERDICT r3 missing #1: the only TPU proof used to be a gitignored
+    stray log)."""
+    import bench
+
+    got = bench._ledger_last_good()
+    assert got is not None and got["platform"] == "tpu"
+    assert got["value"] > 1e8 or got.get("rules")
+
+
 def test_parent_emits_json_when_all_attempts_fail():
     """The driver contract: ONE parseable JSON line and exit 0, no
     matter what.  BENCH_GROUPS=-1 makes every measurement child die in
