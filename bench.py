@@ -672,6 +672,157 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_http(groups: int, seconds: float, clients: int):
+    """BASELINE config 1: the real 3-process cluster driven over HTTP.
+
+    The reference's observable unit of work is HTTP PUT -> 204 after
+    commit + apply (/root/reference/httpapi.go:38-49); this is the one
+    configuration the reference actually ships (Procfile), measured end
+    to end: three server/main.py OS processes, TCP raft transport,
+    WAL + SQLite apply, concurrent keep-alive HTTP clients.  Reports
+    req/s and true per-request wall-clock latency percentiles.
+    """
+    import http.client
+    import shutil
+    import socket
+    import subprocess as sp
+    import tempfile
+    import threading
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    raft_ports = [free_port() for _ in range(3)]
+    api_ports = [free_port() for _ in range(3)]
+    cluster = ",".join(f"http://127.0.0.1:{p}" for p in raft_ports)
+    tmp = tempfile.mkdtemp(prefix="bench-http-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(os.path.join(tmp, "servers.log"), "w")
+    procs = []
+    try:
+        for i in range(3):
+            procs.append(sp.Popen(
+                [sys.executable, "-m", "raftsql_tpu.server.main",
+                 "--cluster", cluster, "--id", str(i + 1),
+                 "--port", str(api_ports[i]), "--groups", str(groups),
+                 "--tick", os.environ.get("BENCH_HTTP_TICK", "0.005")],
+                cwd=tmp, env=env, stdout=logf, stderr=logf))
+        # Readiness: PUT blocks until commit+apply, so the first 204
+        # proves election + full pipeline.  Schema per group.
+        deadline = time.monotonic() + 120
+        for g in range(groups):
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "cluster not ready in 120s; servers.log tail: "
+                        + open(os.path.join(tmp, "servers.log"))
+                        .read()[-800:])
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1",
+                                                   api_ports[0], timeout=10)
+                    c.request("PUT", "/", body=b"CREATE TABLE t (v text)",
+                              headers={"X-Raft-Group": str(g)})
+                    # 204 = created; 400 "already exists" = an earlier
+                    # attempt (whose ack we missed to a client timeout)
+                    # committed + applied — either way the full pipeline
+                    # answered, i.e. the cluster is serving.
+                    if c.getresponse().status in (204, 400):
+                        c.close()
+                        break
+                    c.close()
+                except OSError:
+                    pass
+                time.sleep(0.5)
+        _log(f"  cluster of 3 ready ({groups} groups) on api ports "
+             f"{api_ports}")
+
+        stop_at = time.monotonic() + seconds
+        lats: list = []
+        errs = [0]
+        mu = threading.Lock()
+
+        def client(ci: int) -> None:
+            port = api_ports[ci % 3]
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            my_lats = []
+            my_errs = 0
+            k = 0
+            while time.monotonic() < stop_at:
+                g = (ci + k) % groups
+                k += 1
+                t0 = time.perf_counter()
+                try:
+                    conn.request(
+                        "PUT", "/",
+                        body=f"INSERT INTO t (v) VALUES ('c{ci}_{k}')"
+                        .encode(),
+                        headers={"X-Raft-Group": str(g)})
+                    ok = conn.getresponse()
+                    ok.read()
+                    if ok.status != 204:
+                        my_errs += 1
+                        continue
+                except OSError:
+                    my_errs += 1
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=30)
+                    continue
+                my_lats.append(time.perf_counter() - t0)
+            with mu:
+                lats.extend(my_lats)
+                errs[0] += my_errs
+            conn.close()
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        # Read-side spot check: every replica serves the (stale-ok) read.
+        got = None
+        for p in api_ports:
+            c = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+            c.request("GET", "/", body=b"SELECT count(*) FROM t")
+            r = c.getresponse()
+            got = r.read().decode()
+            assert r.status == 200, (r.status, got)
+            c.close()
+        if not lats:
+            raise RuntimeError(f"no successful PUTs ({errs[0]} errors)")
+        lats.sort()
+
+        def pct(p):
+            return round(lats[int(p * (len(lats) - 1))] * 1e3, 3)
+
+        rate = len(lats) / dt
+        stats = {"p50_ms": pct(0.5), "p99_ms": pct(0.99),
+                 "n": len(lats), "errors": errs[0], "clients": clients,
+                 "groups": groups, "replica_rows": got.strip()}
+        _log(f"  {len(lats)} HTTP PUTs in {dt:.1f}s -> {rate:,.0f} req/s; "
+             f"p50={stats['p50_ms']} ms p99={stats['p99_ms']} ms, "
+             f"{errs[0]} errors")
+        return rate, {"http_lat": stats}
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        logf.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_rules_race(groups: int, peers: int, ticks: int, repeats: int
                      ) -> dict:
     """Race the three commit-advance kernels at the same shape.
@@ -741,6 +892,11 @@ def run_config(config: str, cpu: bool):
     if config == "latency":
         sweep = bench_latency_sweep(groups, peers, repeats)
         return (_light_row(sweep).get("p50_ms") or 0.0, {"lat": sweep})
+    if config == "http":
+        return bench_http(
+            int(os.environ.get("BENCH_GROUPS", "8")),
+            float(os.environ.get("BENCH_HTTP_SECONDS", "10")),
+            int(os.environ.get("BENCH_HTTP_CLIENTS", "16")))
     if config == "durable":
         # sqlite keeps one DB file (3 fds with -wal/-shm) per group: stay
         # well under the default open-files rlimit.
@@ -994,6 +1150,16 @@ def main() -> None:
             extra_env={"BENCH_CONFIG": "durable"},
             label="durable-cpu")
 
+    # -- 3a'. end-to-end HTTP child (BASELINE config 1): the 3-process
+    # Procfile cluster over real HTTP PUT/GET — the one configuration
+    # the reference actually ships (VERDICT r3 task 3).
+    httpc = None
+    if os.environ.get("BENCH_SKIP_HTTP") != "1" \
+            and remaining() > fallback_reserve + 150:
+        httpc = _attempt(
+            "cpu", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "http"}, label="http-cpu")
+
     # -- 3a. late re-probe (VERDICT r3 task 8): a tunnel that was wedged
     # during the early probes but recovered mid-budget was never noticed
     # — round 3 lost its TPU headline to exactly this.  If the ladder
@@ -1083,6 +1249,9 @@ def main() -> None:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
+        if httpc:
+            parsed["http_req_per_s"] = httpc.get("value")
+            parsed["http_lat"] = httpc.get("http_lat")
         _emit(parsed)
         return
 
@@ -1101,6 +1270,9 @@ def main() -> None:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
+        if httpc:
+            parsed["http_req_per_s"] = httpc.get("value")
+            parsed["http_lat"] = httpc.get("http_lat")
         # Clearly-labeled history, not a headline: the newest committed
         # TPU_RUNS.jsonl entry, so a wedged tunnel leaves a citable
         # last-known-good TPU result in the official record.

