@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from raftsql_tpu.config import RaftConfig
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     empty_inbox, init_peer_state)
-from raftsql_tpu.core.step import peer_step
+from raftsql_tpu.core.step import pack_info, peer_step
 
 
 def init_cluster_state(cfg: RaftConfig, seed: int | None = None) -> PeerState:
@@ -74,6 +74,18 @@ def cluster_step(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
 def cluster_step_jit(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
                      prop_n: jax.Array):
     return cluster_step(cfg, states, inboxes, prop_n)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
+                      prop_n: jax.Array):
+    """Fused step for the DURABLE co-located runtime (runtime/fused.py):
+    messages stay on device (the delivered inboxes are returned as
+    opaque carry), and the host-facing StepInfo crosses as ONE packed
+    [P, G, INFO_NCOLS] array (core/step.py pack_info) — the host pays a
+    single transfer per tick however many peers and groups advance."""
+    st, ib, infos = cluster_step(cfg, states, inboxes, prop_n)
+    return st, ib, jax.vmap(pack_info)(infos)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))

@@ -140,6 +140,13 @@ class StepInfo(NamedTuple):
     # (core/step.py floor1): the device suppresses real appends to
     # followers below it, so the host must serve them.
     floor: jax.Array         # i32 [G]
+    # Scalar i32: minimum timer ticks (across all groups) until ANY
+    # election or heartbeat timer could fire, given no inbound messages.
+    # The host's event loop skips whole steps while its accumulated
+    # timer advance stays below this margin and nothing is staged
+    # (runtime/node.py _run) — an idle node costs ~zero CPU between
+    # heartbeats instead of a full step per tick interval.
+    timer_margin: jax.Array  # i32 []
 
 
 def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,

@@ -45,7 +45,9 @@ from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
 
 def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
               prop_n: jax.Array, self_id: jax.Array,
-              group_offset: jax.Array | int = 0
+              group_offset: jax.Array | int = 0,
+              timer_inc: jax.Array | int = 1,
+              force_bcast: jax.Array | bool = False
               ) -> Tuple[PeerState, Outbox, StepInfo]:
     """Advance one peer's view of all G groups by one tick.
 
@@ -61,6 +63,21 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         jitter is drawn per GLOBAL group id, so a mesh-sharded run
         (parallel/sharded.py, where this peer sees a G/gg-row block)
         draws bit-identical timeouts to the single-chip run.
+      timer_inc: scalar i32, 0 or 1 — how far the real-time timers
+        (election `elapsed`, leader `hb_elapsed`) advance this step.
+        The host's event-driven loop (runtime/node.py) runs extra
+        work-triggered steps with timer_inc=0 so message processing can
+        outpace the wall-clock tick without distorting election or
+        heartbeat timing; interval-paced steps pass 1 (the reference's
+        100 ms Tick(), raft.go:207, is exactly the timer_inc=1 cadence).
+        Values > 1 apply several intervals of advance at once — the host
+        elides steps while nothing can fire (info.timer_margin) and
+        settles the accumulated advance on the next real step.
+      force_bcast: scalar bool — leaders broadcast an append/heartbeat
+        round THIS step regardless of heartbeat countdown.  The host
+        sets it when a linearizable read registers (runtime/node.py
+        read_index): the ReadIndex quorum round must not wait out the
+        heartbeat interval.
 
     Returns:
       (new_state, outbox, info).  `outbox[g, dst]` is the dense message set
@@ -382,7 +399,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
 
     # ---- Phase 8: timers and election start.
     reset = any_grant | any_app
-    elapsed = jnp.where(is_leader | reset, 0, state.elapsed + 1)
+    elapsed = jnp.where(is_leader | reset, 0, state.elapsed + timer_inc)
     fire = (role != LEADER) & (elapsed >= state.timeout)
     term_resp = term          # term used in responses composed above
     if cfg.prevote:
@@ -412,9 +429,14 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         cfg.election_ticks, 2 * cfg.election_ticks)
     timeout = jnp.where(fire, new_timeout, state.timeout)
 
-    hb = jnp.where(is_leader, state.hb_elapsed + 1, 0)
+    hb = jnp.where(is_leader, state.hb_elapsed + timer_inc, 0)
+    # commit > commit0: broadcast the new commit index NOW rather than on
+    # the next heartbeat — a follower-proposed entry's ack waits on its
+    # proposer LEARNING the commit, and heartbeat-paced propagation put a
+    # ~heartbeat/2 floor under propose→ack latency under light load.
     hb_fire = is_leader & ((hb >= cfg.heartbeat_ticks) | become_leader
-                           | (total_app > 0))
+                           | (total_app > 0) | force_bcast
+                           | (commit > commit0))
     hb = jnp.where(hb_fire, 0, hb)
 
     # ---- Phase 9: compose the outbox.  Write order = priority order:
@@ -557,6 +579,17 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         votes=votes, match=match, next_idx=next_idx,
         rng=state.rng, tick=state.tick + 1)
 
+    # Ticks until any timer could fire with no further input: non-leader
+    # election countdown vs leader heartbeat countdown, min over groups,
+    # clamped >= 1 (the step that fires a timer resets it, so the true
+    # margin after a step is always positive).
+    is_leader2 = role == LEADER
+    big = jnp.int32(1 << 30)
+    elec_rem = jnp.where(is_leader2, big, timeout - elapsed)
+    hb_rem = jnp.where(is_leader2, cfg.heartbeat_ticks - hb, big)
+    timer_margin = jnp.maximum(
+        jnp.minimum(jnp.min(elec_rem), jnp.min(hb_rem)), 1)
+
     info = StepInfo(
         commit=commit, role=role, term=term, voted_for=voted,
         leader_hint=leader_hint,
@@ -567,12 +600,75 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         app_conflict=conflict,
         new_log_len=log_len,
         next_idx=next_idx,
-        floor=floor1)
+        floor=floor1,
+        timer_margin=timer_margin)
 
     return new_state, outbox, info
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))
 def peer_step_jit(cfg: RaftConfig, state: PeerState, inbox: Inbox,
-                  prop_n: jax.Array, self_id: jax.Array):
-    return peer_step(cfg, state, inbox, prop_n, self_id)
+                  prop_n: jax.Array, self_id: jax.Array,
+                  timer_inc: jax.Array | int = 1,
+                  force_bcast: jax.Array | bool = False):
+    return peer_step(cfg, state, inbox, prop_n, self_id,
+                     timer_inc=timer_inc, force_bcast=force_bcast)
+
+
+# ---------------------------------------------------------------------------
+# Packed host boundary.
+#
+# The runtime's tick (runtime/node.py) crosses host<->device once per step;
+# shipping the Inbox as 14 arrays and reading back Outbox+StepInfo as ~30
+# cost ~8x the step kernel itself in per-array dispatch overhead at small G
+# (measured: 5.7 ms vs 0.7 ms per step, 3 contended processes, CPU
+# backend).  The packed forms move ONE array each way; the slices/stack
+# below happen inside the compiled program where XLA fuses them to nothing.
+
+# Column order of the packed [G, P, IB_NCOLS + E] message block (shared by
+# inbox and outbox; a_ents occupies the trailing E columns).
+MSG_FIELDS = ("v_type", "v_term", "v_last_idx", "v_last_term", "v_granted",
+              "a_type", "a_term", "a_prev_idx", "a_prev_term", "a_n",
+              "a_commit", "a_success", "a_match")
+IB_NCOLS = len(MSG_FIELDS)
+# Column order of the packed [G, INFO_NCOLS] StepInfo block (next_idx and
+# timer_margin ride alongside, unpacked).
+INFO_FIELDS = ("commit", "role", "term", "voted_for", "leader_hint",
+               "prop_base", "prop_accepted", "noop", "app_from",
+               "app_start", "app_n", "app_conflict", "new_log_len",
+               "floor")
+INFO_NCOLS = len(INFO_FIELDS)
+
+
+def unpack_inbox(packed: jax.Array) -> Inbox:
+    f = {n: packed[:, :, i] for i, n in enumerate(MSG_FIELDS)}
+    f["v_granted"] = f["v_granted"].astype(bool)
+    f["a_success"] = f["a_success"].astype(bool)
+    return Inbox(a_ents=packed[:, :, IB_NCOLS:], **f)
+
+
+def pack_outbox(ob: Outbox) -> jax.Array:
+    head = jnp.stack([getattr(ob, n).astype(I32) for n in MSG_FIELDS],
+                     axis=-1)
+    return jnp.concatenate([head, ob.a_ents.astype(I32)], axis=-1)
+
+
+def pack_info(info: StepInfo) -> jax.Array:
+    return jnp.stack([getattr(info, n).astype(I32) for n in INFO_FIELDS],
+                     axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def peer_step_packed(cfg: RaftConfig, state: PeerState, packed: jax.Array,
+                     prop_n: jax.Array, self_id: jax.Array,
+                     timer_inc: jax.Array | int = 1,
+                     force_bcast: jax.Array | bool = False):
+    """peer_step with single-array host I/O: `packed` is
+    [G, P, IB_NCOLS+E] i32; returns (state, packed_outbox [G, P,
+    IB_NCOLS+E], packed_info [G, INFO_NCOLS], next_idx [G, P],
+    timer_margin [])."""
+    st, ob, info = peer_step(cfg, state, unpack_inbox(packed), prop_n,
+                             self_id, timer_inc=timer_inc,
+                             force_bcast=force_bcast)
+    return (st, pack_outbox(ob), pack_info(info), info.next_idx,
+            info.timer_margin)

@@ -93,7 +93,7 @@ def info_specs() -> StepInfo:
         prop_base=s2, prop_accepted=s2, noop=s2,
         app_from=s2, app_start=s2, app_n=s2, app_conflict=s2,
         new_log_len=s2, next_idx=P(PEERS_AXIS, GROUPS_AXIS, None),
-        floor=s2)
+        floor=s2, timer_margin=P(PEERS_AXIS))
 
 
 def shard_cluster_arrays(mesh: Mesh, states: PeerState, inboxes: Inbox,
@@ -151,6 +151,12 @@ def make_sharded_step_fn(cfg: RaftConfig, mesh: Mesh):
                 local_cfg, st, ib, pn, sid, goff))(
                     states, inboxes, prop_n, self_ids)
         delivered = jax.tree.map(lambda x: _route(x, pp), outboxes)
+        # timer_margin is a per-(peer, group-shard) min; the host wants
+        # the per-peer min over ALL groups, so reduce it over the group
+        # axis here — that also makes the P(PEERS_AXIS) out_spec's
+        # replication-over-groups claim true by construction.
+        infos = infos._replace(timer_margin=jax.lax.pmin(
+            infos.timer_margin, GROUPS_AXIS))
         return new_states, delivered, infos
 
     return _step

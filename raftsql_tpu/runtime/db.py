@@ -37,7 +37,7 @@ from typing import Callable, Dict, Optional, Tuple
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
 from raftsql_tpu.runtime.envelope import unwrap
-from raftsql_tpu.runtime.node import CLOSED
+from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH
 from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
@@ -45,24 +45,22 @@ from raftsql_tpu.utils.metrics import LatencyTimer
 def _expand_commit_item(item, node=None):
     """Normalize a commit_q item to per-entry (group, index, sql) tuples.
 
-    Three forms:
-      - (group, base_idx, [raw_bytes, ...]) — the live publish phase's
-        RAW batch (entries at base_idx+1..): one queue put per group per
-        tick, with the per-entry envelope unwrap / dedup / utf-8 decode
-        done HERE, on the consumer thread, off the tick's critical path
-        (`node` supplies the per-group DedupWindow — forward-retried
+    Three forms, discriminated explicitly:
+      - (RAW_BATCH, group, base_idx, [raw_bytes, ...]) — the live
+        publish phase's tagged batch (entries at base_idx+1..): one
+        queue put per group per tick, with the per-entry envelope
+        unwrap / dedup / utf-8 decode done HERE, on the consumer
+        thread, off the tick's critical path (`node.dedup_for(g)`
+        supplies the per-group DedupWindow — forward-retried
         duplicates apply exactly once);
       - (group, index, sql_str) — WAL replay per-entry items (the
         nil-sentinel counting protocol must stay item-accurate there);
       - (group, [(index, sql), ...]) — decoded per-group batches (older
         producers/tests).
     """
-    if len(item) == 2:
-        g = item[0]
-        return [(g, i, s) for (i, s) in item[1]]
-    if type(item[2]) is list:
-        g, base, datas = item
-        dedup = node._dedup[g] if node is not None else None
+    if item[0] is RAW_BATCH:
+        _, g, base, datas = item
+        dedup = node.dedup_for(g) if node is not None else None
         out = []
         for off, data in enumerate(datas):
             if not data:
@@ -73,7 +71,12 @@ def _expand_commit_item(item, node=None):
                 continue                    # forward-retry duplicate
             out.append((g, base + 1 + off, payload.decode("utf-8")))
         return out
-    return [item]
+    if len(item) == 2:
+        g = item[0]
+        return [(g, i, s) for (i, s) in item[1]]
+    if len(item) == 3 and isinstance(item[2], str):
+        return [item]
+    raise TypeError(f"unrecognized commit_q item shape: {item!r:.120}")
 
 
 class NotLeaderError(Exception):

@@ -1,0 +1,330 @@
+"""FusedClusterNode — the durable co-located deployment.
+
+The distributed runtime (runtime/node.py) runs one RaftNode per process
+and pays one device dispatch per peer per tick; through a remote-TPU
+tunnel each dispatch costs tens of milliseconds, so a P-peer cluster is
+dispatch-bound long before consensus math matters.  When all P peers of
+every group are co-located on ONE chip — the reference's Procfile
+cluster collapsed into a single host process — the TPU-first shape is
+the fused cluster step (core/cluster.py): all P peers × G groups advance
+in one compiled program, messages delivered by an on-device transpose,
+and the host crosses the boundary once per tick with a packed StepInfo.
+
+Durability keeps the reference's per-batch contract (reference
+raft.go:227-235: wal.Save → transport.Send → publish) with the dispatch
+itself as the send barrier:
+
+  messages composed at tick t are OBSERVED by their receivers only
+  inside step t+1 — and the host does not dispatch step t+1 until every
+  peer's tick-t appends and hard states are fsynced.
+
+So a follower's success response (composed at t, seen by the leader at
+t+1) never reaches the leader before the entries it acknowledges are
+durable on the follower — exactly the raft requirement the reference
+gets from saving before sending.  Publish (commit delivery to the apply
+layer) happens after the same tick's save, before the next dispatch.
+
+Payload plane: entry BYTES never touch the device (the step moves
+counts, terms and indexes).  Each peer owns a host PayloadLog + WAL dir;
+a follower that accepts entries mirrors the bytes from the SOURCE peer's
+payload log.  Within one host phase all mirror READS happen before any
+payload-log WRITES: the reads then see exactly the end-of-previous-tick
+state the device composed those appends from, so a same-tick truncation
+on the source cannot tear a mirror.
+
+Scope (documented, not hidden): this runtime targets the co-located
+steady state.  Followers that fall behind the device ring window are
+served by the distributed runtime's host catch-up / InstallSnapshot
+machinery, not here — a fused-mode follower outside the window waits
+for the window to come back around (bounded lag under steady load).
+Crash recovery is full per-peer WAL replay (reference raft.go:122-134).
+"""
+from __future__ import annotations
+
+import os
+import queue
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.core.cluster import (cluster_step_host,
+                                      empty_cluster_inbox,
+                                      init_cluster_state)
+from raftsql_tpu.core.state import restore_peer_state
+from raftsql_tpu.core.step import INFO_FIELDS
+from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH
+from raftsql_tpu.storage.log import PayloadLog
+from raftsql_tpu.storage.wal import WAL, wal_exists
+from raftsql_tpu.utils.metrics import NodeMetrics
+
+_C = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+
+class FusedClusterNode:
+    """P peers × G groups, one device program per tick, durable WALs.
+
+    Public surface mirrors the distributed runtime where it overlaps:
+    `propose_many(group, payloads)` routes to the current leader peer,
+    `tick()` advances the whole cluster one step, `commit_q(peer)` is
+    that peer's totally-ordered commit stream (same item protocol as
+    RaftNode: any replayed (RAW_BATCH, g, base, [bytes...]) batches
+    first, then the None replay-complete sentinel, then live batches;
+    CLOSED ends the stream), `leader_of(group)` reports the last hint.
+    """
+
+    def __init__(self, cfg: RaftConfig, data_dir: str,
+                 seed: Optional[int] = None):
+        P, G = cfg.num_peers, cfg.num_groups
+        self.cfg = cfg
+        self.metrics = NodeMetrics()
+        self.dirs = [os.path.join(data_dir, f"p{i + 1}") for i in range(P)]
+        self.wals: List[WAL] = []
+        self.plogs: List[PayloadLog] = []
+        self._commit_qs: List["queue.Queue"] = [queue.Queue()
+                                                for _ in range(P)]
+        self._applied = np.zeros((P, G), np.int64)
+        self._hard = np.zeros((P, G, 3), np.int64)
+        self._hard[:, :, 1] = -1
+        self._props: List[List[deque]] = [
+            [deque() for _ in range(G)] for _ in range(P)]
+        self._queued: set = set()            # (peer, group) with backlog
+        self._hints = np.full(G, -1, np.int64)
+        self._tick_no = 0
+
+        states = []
+        for p in range(P):
+            d = self.dirs[p]
+            if wal_exists(d):
+                states.append(self._replay_peer(p, d, seed))
+            else:
+                os.makedirs(d, exist_ok=True)
+                self.wals.append(WAL(d,
+                                     segment_bytes=cfg.wal_segment_bytes))
+                self.plogs.append(PayloadLog(G))
+                states.append(None)
+            # Replay-complete sentinel, replayed-or-not (the reference's
+            # nil on commitC, raft.go:131-132).
+            self._commit_qs[p].put(None)
+        if all(s is None for s in states):
+            self.states = init_cluster_state(cfg, seed)
+        else:
+            per_peer = [s if s is not None
+                        else restore_peer_state(cfg, p, {}, {}, seed)
+                        for p, s in enumerate(states)]
+            self.states = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                       *per_peer)
+        self.inboxes = empty_cluster_inbox(cfg)
+        self._E = cfg.max_entries_per_msg
+
+    # -- boot -----------------------------------------------------------
+
+    def _replay_peer(self, p: int, d: str, seed):
+        """Rebuild peer p from its WAL (RestartNode, raft.go:122-134):
+        device state, payload log, and the replayed committed prefix
+        published to its commit stream."""
+        logs = WAL.replay(d)
+        self.wals.append(WAL(d, segment_bytes=self.cfg.wal_segment_bytes))
+        plog = PayloadLog(self.cfg.num_groups)
+        self.plogs.append(plog)
+        log_terms: Dict[int, list] = {}
+        hard: Dict[int, tuple] = {}
+        starts: Dict[int, tuple] = {}
+        for g, gl in logs.items():
+            log_terms[g] = [t for (t, _) in gl.entries]
+            hard[g] = (gl.hard.term, gl.hard.vote, gl.hard.commit)
+            if gl.start:
+                starts[g] = (gl.start, gl.start_term)
+                plog.set_start(g, gl.start, gl.start_term)
+            plog.put(g, gl.start + 1, [dt for (_, dt) in gl.entries],
+                     [t for (t, _) in gl.entries])
+            self._hard[p, g] = hard[g]
+            commit = gl.hard.commit
+            self._applied[p, g] = commit
+            datas = plog.try_slice(g, gl.start + 1,
+                                   max(commit - gl.start, 0))
+            if datas:
+                self._commit_qs[p].put((RAW_BATCH, g, gl.start, datas))
+        return restore_peer_state(self.cfg, p, log_terms, hard, seed,
+                                  starts=starts or None)
+
+    # -- client plane ---------------------------------------------------
+
+    def commit_q(self, peer: int) -> "queue.Queue":
+        return self._commit_qs[peer]
+
+    def leader_of(self, group: int) -> int:
+        """Last known leader peer (0-based), -1 unknown."""
+        return int(self._hints[group])
+
+    def propose_many(self, group: int, payloads) -> None:
+        """Queue payloads at the group's current leader peer (host-side
+        routing — all peers share this process; the distributed
+        runtime's forward-over-transport becomes a deque move)."""
+        p = int(self._hints[group])
+        if p < 0:
+            p = 0
+        self._props[p][group].extend(payloads)
+        self._queued.add((p, group))
+
+    # -- the tick -------------------------------------------------------
+
+    def _build_prop_n(self) -> np.ndarray:
+        P, G = self.cfg.num_peers, self.cfg.num_groups
+        prop_n = np.zeros((P, G), np.int32)
+        dead = []
+        for (p, g) in list(self._queued):     # snapshot: re-routes mutate
+            q = self._props[p][g]
+            if not q:
+                dead.append((p, g))
+                continue
+            h = int(self._hints[g])
+            if 0 <= h != p:
+                # Re-route a backlog stranded at a deposed/wrong peer.
+                self._props[h][g].extend(q)
+                q.clear()
+                self._queued.add((h, g))
+                dead.append((p, g))
+                continue
+            prop_n[p, g] = min(len(q), self._E)
+        for k in dead:
+            self._queued.discard(k)
+        return prop_n
+
+    def tick(self) -> None:
+        """One fused step + the durable host phase.
+
+        Order within the tick (the contract in the module docstring):
+        dispatch → read packed info → mirror-reads → WAL/payload writes
+        → fsync (all peers) → publish.  The NEXT dispatch cannot happen
+        before this method returns, so every message composed this tick
+        is durable on its sender before any receiver observes it.
+        """
+        import time as _t
+        cfg = self.cfg
+        P = cfg.num_peers
+        t0 = _t.monotonic()
+        # Snapshot _queued: _build_prop_n may re-route into the set.
+        prop_n = self._build_prop_n()
+        self.states, self.inboxes, pinfo = cluster_step_host(
+            cfg, self.states, self.inboxes, jnp.asarray(prop_n))
+        pinfo = np.asarray(jax.device_get(pinfo))     # [P, G, NCOLS]
+        t1 = _t.monotonic()
+        self.metrics.t_device_ms += (t1 - t0) * 1e3
+
+        self._hints = pinfo[0, :, _C["leader_hint"]]
+
+        # Phase 1: mirror READS for every follower-accepted append, all
+        # peers, before any payload-log write of this tick.
+        mirrors: List[Tuple[int, int, int, int, list]] = []
+        for p in range(P):
+            col = pinfo[p]
+            accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
+            for g in accepted.tolist():
+                src = int(col[g, _C["app_from"]])
+                start = int(col[g, _C["app_start"]])
+                n = int(col[g, _C["app_n"]])
+                new_len = int(col[g, _C["new_log_len"]])
+                ents = self.plogs[src].slice_with_terms(g, start, n) \
+                    if n else []
+                mirrors.append((p, g, start, new_len, ents))
+
+        # Phase 2: WAL + payload-log writes, then one fsync per peer.
+        for p in range(P):
+            col = pinfo[p]
+            w_g: List[int] = []
+            w_i: List[int] = []
+            w_t: List[int] = []
+            w_d: List[bytes] = []
+            noop = col[:, _C["noop"]]
+            acc = col[:, _C["prop_accepted"]]
+            lead_active = np.nonzero((noop != 0) | (acc > 0))[0]
+            for g in lead_active.tolist():
+                base = int(col[g, _C["prop_base"]])
+                term = int(col[g, _C["term"]])
+                if noop[g]:
+                    w_g.append(g)
+                    w_i.append(base)
+                    w_t.append(term)
+                    w_d.append(b"")
+                    self.plogs[p].put(g, base, [b""], [term])
+                n = int(acc[g])
+                if n:
+                    q = self._props[p][g]
+                    batch = [q.popleft() for _ in range(n)]
+                    w_g.extend([g] * n)
+                    w_i.extend(range(base + 1, base + 1 + n))
+                    w_t.extend([term] * n)
+                    w_d.extend(batch)
+                    self.plogs[p].put(g, base + 1, batch, [term] * n)
+                    self.metrics.proposals += n
+        # Mirrors write AFTER all leader tail-appends of this tick are
+        # in — a (deposed-leader, fresh-follower) peer could otherwise
+        # interleave, but mirror content was already read in phase 1
+        # so ordering here only affects which write wins the suffix:
+        # the device's accept decision (the mirror) must win.
+            for (mp, g, start, new_len, ents) in mirrors:
+                if mp != p:
+                    continue
+                terms = [t for (t, _) in ents]
+                datas = [d for (_, d) in ents]
+                self.plogs[p].put(g, start, datas, terms,
+                                  new_len=new_len)
+                w_g.extend([g] * len(ents))
+                w_i.extend(range(start, start + len(ents)))
+                w_t.extend(terms)
+                w_d.extend(datas)
+            hs = np.stack([col[:, _C["term"]], col[:, _C["voted_for"]],
+                           col[:, _C["commit"]]], axis=1)
+            changed = np.nonzero((hs != self._hard[p]).any(axis=1))[0]
+            if w_g:
+                self.wals[p].append_entries(w_g, w_i, w_t, w_d)
+            if changed.size:
+                self.wals[p].set_hardstates(changed, hs[changed, 0],
+                                            hs[changed, 1],
+                                            hs[changed, 2])
+                self._hard[p][changed] = hs[changed]
+            self.wals[p].sync()          # the durable barrier, per peer
+        t2 = _t.monotonic()
+        self.metrics.t_wal_ms += (t2 - t1) * 1e3
+
+        # Phase 3: publish (after save, before the next dispatch).
+        for p in range(P):
+            col = pinfo[p]
+            commit = col[:, _C["commit"]]
+            ready = np.nonzero(commit > self._applied[p])[0]
+            for g in ready.tolist():
+                c = int(commit[g])
+                a = int(self._applied[p][g])
+                datas = self.plogs[p].slice(g, a + 1, c - a)
+                if len(datas) != c - a:
+                    raise RuntimeError(
+                        f"peer {p} g{g}: payload log shorter than "
+                        f"commit ({a}+{len(datas)} < {c})")
+                if any(datas):
+                    self._commit_qs[p].put((RAW_BATCH, g, a, datas))
+                self._applied[p][g] = c
+                if p == 0:
+                    self.metrics.commits += c - a
+        t3 = _t.monotonic()
+        self.metrics.t_publish_ms += (t3 - t2) * 1e3
+        self._tick_no += 1
+        self.metrics.ticks += 1
+
+    # -- teardown -------------------------------------------------------
+
+    def stop(self) -> None:
+        for w in self.wals:
+            w.close()
+        for q in self._commit_qs:
+            q.put(CLOSED)
+
+    # -- introspection (tests) -----------------------------------------
+
+    def roles(self) -> np.ndarray:
+        """[P, G] role matrix from the live device state."""
+        return np.asarray(self.states.role)

@@ -27,6 +27,7 @@ Host responsibilities (the device owns ordering/quorum math only):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -40,9 +41,10 @@ import jax.numpy as jnp
 
 from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP,
                                 NO_VOTE, RaftConfig)
-from raftsql_tpu.core.state import (Inbox, install_snapshot_state,
+from raftsql_tpu.core.state import (install_snapshot_state,
                                     restore_peer_state, set_peer_progress)
-from raftsql_tpu.core.step import peer_step_jit
+from raftsql_tpu.core.step import (IB_NCOLS, INFO_FIELDS, MSG_FIELDS,
+                                   peer_step_packed)
 from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
                                           unwrap_snapshot, wrap,
                                           wrap_snapshot)
@@ -58,6 +60,36 @@ log = logging.getLogger("raftsql_tpu.node")
 # Commit-queue sentinel marking end-of-stream (the reference closes the
 # channel; Python queues need an explicit object).
 CLOSED = object()
+
+class _PackedView:
+    """Attribute access over columns of a packed numpy array — the
+    Outbox/StepInfo face the tick phases consume, backed by free views
+    into the ONE array device_get returns (core/step.py packed forms)."""
+
+    def __init__(self, **cols):
+        self.__dict__.update(cols)
+
+
+def _view_outbox(arr: np.ndarray) -> _PackedView:
+    v = _PackedView(**{n: arr[:, :, i] for i, n in enumerate(MSG_FIELDS)})
+    v.a_ents = arr[:, :, IB_NCOLS:]
+    return v
+
+
+def _view_info(ginfo: np.ndarray, next_idx: np.ndarray) -> _PackedView:
+    v = _PackedView(**{n: ginfo[:, i] for i, n in enumerate(INFO_FIELDS)})
+    v.noop = v.noop.astype(bool)
+    v.app_conflict = v.app_conflict.astype(bool)
+    v.next_idx = next_idx
+    return v
+
+
+# Discriminator heading a live publish-phase commit item:
+# (RAW_BATCH, group, base_idx, [raw_bytes, ...]).  The queue carries
+# three item shapes (see runtime/db.py _expand_commit_item); the raw
+# form is the only one whose payloads still need envelope unwrap/dedup,
+# so it is tagged explicitly rather than sniffed by payload type.
+RAW_BATCH = object()
 
 
 class RaftNode:
@@ -98,11 +130,18 @@ class RaftNode:
         # of the newest COLUMNAR append in the slot, _stage_app_arr the
         # stamp of the staged record — inbox build lets the newer one win,
         # whatever its form ("newest message per (group, src, slot) wins").
-        self._stg: Dict[str, np.ndarray] = self._fresh_stage_cols()
+        self._stg: np.ndarray = self._fresh_stage_cols()
         self._stg_a_seq = np.zeros((G, num_nodes), np.int64)
         self._stg_a_arr = np.zeros((G, num_nodes), np.int64)
         self._stage_app_arr: Dict[Tuple[int, int], int] = {}
         self._arrival = 0
+        # True iff anything was staged since the last inbox build; a
+        # clean build reuses the prebuilt all-zero device inbox instead
+        # of allocating + converting ~30 arrays per step (at small G the
+        # conversions, not the kernel, dominated step cost).
+        self._stage_dirty = False
+        self._zero_inbox = None          # built lazily (needs jnp)
+        self._zero_seq = np.zeros((G, num_nodes), np.int64)
 
         # InstallSnapshot hooks (wired by the apply layer in resume mode;
         # both unset => full state transfer disabled, catch-up below the
@@ -173,6 +212,13 @@ class RaftNode:
         self._hard_np[:, 1] = NO_VOTE
 
         self._stop_evt = threading.Event()
+        # Work signal for the event-driven loop (_run): set whenever a
+        # proposal, inbound peer batch, or linearizable-read registration
+        # arrives, so the next step runs immediately (timer_inc=0)
+        # instead of waiting out the tick interval.  The interval-paced
+        # steps (timer_inc=1) remain the only ones that advance election
+        # and heartbeat timers — real-time raft semantics are unchanged.
+        self._work_evt = threading.Event()
         self._stopped = False           # full teardown ran (stop())
         self._thread: Optional[threading.Thread] = None
         self._tick_apps: Dict[Tuple[int, int], AppendRec] = {}
@@ -205,6 +251,18 @@ class RaftNode:
         self._replay_groups = groups
         self.wal = WAL(data_dir, segment_bytes=cfg.wal_segment_bytes)
         self._self_arr = jnp.asarray(self.self_id, jnp.int32)
+        # timer_inc constants for the step call: index by advance_timers.
+        self._ti_arr = (jnp.asarray(0, jnp.int32),
+                        jnp.asarray(1, jnp.int32))
+        # Device-reported minimum ticks until any timer fires; 1 until
+        # the first step reports (see _run / core/step.py timer_margin).
+        self._timer_margin = 1
+        # One-shot broadcast nudge (core/step.py force_bcast): set by
+        # read_index so the ReadIndex confirm round goes out on the next
+        # step instead of the next heartbeat.  Benign race: a lost
+        # concurrent set only delays the round to the heartbeat.
+        self._force_bcast = False
+        self._fb_arr = (jnp.asarray(False), jnp.asarray(True))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -234,6 +292,7 @@ class RaftNode:
             return
         self._stopped = True
         self._stop_evt.set()
+        self._work_evt.set()     # wake a margin-length idle sleep NOW
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.transport.stop()
@@ -246,6 +305,7 @@ class RaftNode:
         log.error("node %d transport error: %s", self.node_id, err)
         self.error = err
         self._stop_evt.set()
+        self._work_evt.set()     # wake a margin-length idle sleep NOW
         self.commit_q.put(CLOSED)
 
     # ------------------------------------------------------------------
@@ -264,6 +324,7 @@ class RaftNode:
             self._props[group].append(wrap(payload))
             self._prop_len[group] += 1
             self._fwd_groups.add(group)
+        self._work_evt.set()
 
     def propose_many(self, group: int, payloads) -> None:
         """Batch `propose`: one lock hold and envelope pass for a whole
@@ -277,6 +338,7 @@ class RaftNode:
             self._props[group].extend(wrapped)
             self._prop_len[group] += len(wrapped)
             self._fwd_groups.add(group)
+        self._work_evt.set()
 
     def _decode_entry(self, group: int, data: bytes,
                       idx: int = 0) -> Optional[str]:
@@ -290,6 +352,17 @@ class RaftNode:
         if pid is not None and self._dedup[group].seen(pid, idx):
             return None
         return payload.decode("utf-8")
+
+    def dedup_for(self, group: int) -> DedupWindow:
+        """The group's forward-retry dedup window, for commit-queue
+        consumers expanding RAW_BATCH items on their own thread.
+
+        Threading contract (the reason this is an accessor and not a
+        reach into _dedup): `seen()` is called by the consumer thread;
+        `pairs_upto()`/`restore()` run on the tick thread. DedupWindow
+        orders those safely internally; no other methods are
+        cross-thread."""
+        return self._dedup[group]
 
     def leader_of(self, group: int) -> int:
         """Last known leader (0-based peer), -1 if unknown.
@@ -316,6 +389,11 @@ class RaftNode:
         (caller should redirect to `leader_of`)."""
         if self._last_role[group] != LEADER:
             return None
+        # Nudge a broadcast round out on the next step: the quorum
+        # confirmation (and, while the precondition is pending, the
+        # no-op's replication) must not wait for the heartbeat interval.
+        self._force_bcast = True
+        self._work_evt.set()
         commit = int(self._hard_np[group, 2])
         term = int(self._hard_np[group, 0])
         # try_term_of: this runs on CLIENT threads racing the tick thread
@@ -397,40 +475,43 @@ class RaftNode:
     # ------------------------------------------------------------------
     # transport plane
 
-    _STAGE_FIELDS = ("v_type", "v_term", "v_last_idx", "v_last_term",
-                     "v_granted", "a_type", "a_term", "a_prev_idx",
-                     "a_prev_term", "a_commit", "a_success", "a_match")
+    # Column index per field in the packed [G, P, IB_NCOLS+E] staging
+    # buffer (core/step.py MSG_FIELDS order; a_ents in the trailing E).
+    _COL = {n: i for i, n in enumerate(MSG_FIELDS)}
 
-    def _fresh_stage_cols(self) -> Dict[str, np.ndarray]:
-        G, P = self.cfg.num_groups, self.num_nodes
-        return {f: np.zeros((G, P), np.int32) for f in self._STAGE_FIELDS}
+    def _fresh_stage_cols(self) -> np.ndarray:
+        G, P, E = (self.cfg.num_groups, self.num_nodes,
+                   self.cfg.max_entries_per_msg)
+        return np.zeros((G, P, IB_NCOLS + E), np.int32)
 
     def _stage_cols(self, src0: int, c) -> None:
-        """Scatter one ColRecs into the staging arrays (stage-lock held).
+        """Scatter one ColRecs into the packed staging buffer
+        (stage-lock held).
 
         Row validation is one vectorized mask (bad groups dropped, same
         contract as the record path)."""
         G = self.cfg.num_groups
+        C = self._COL
         if c.n_votes():
             m = (c.v_group >= 0) & (c.v_group < G)
             g = c.v_group[m]
             s = self._stg
-            s["v_type"][g, src0] = c.v_type[m]
-            s["v_term"][g, src0] = c.v_term[m]
-            s["v_last_idx"][g, src0] = c.v_last_idx[m]
-            s["v_last_term"][g, src0] = c.v_last_term[m]
-            s["v_granted"][g, src0] = c.v_granted[m]
+            s[g, src0, C["v_type"]] = c.v_type[m]
+            s[g, src0, C["v_term"]] = c.v_term[m]
+            s[g, src0, C["v_last_idx"]] = c.v_last_idx[m]
+            s[g, src0, C["v_last_term"]] = c.v_last_term[m]
+            s[g, src0, C["v_granted"]] = c.v_granted[m]
         if c.n_appends():
             m = (c.a_group >= 0) & (c.a_group < G)
             g = c.a_group[m]
             s = self._stg
-            s["a_type"][g, src0] = c.a_type[m]
-            s["a_term"][g, src0] = c.a_term[m]
-            s["a_prev_idx"][g, src0] = c.a_prev_idx[m]
-            s["a_prev_term"][g, src0] = c.a_prev_term[m]
-            s["a_commit"][g, src0] = c.a_commit[m]
-            s["a_success"][g, src0] = c.a_success[m]
-            s["a_match"][g, src0] = c.a_match[m]
+            s[g, src0, C["a_type"]] = c.a_type[m]
+            s[g, src0, C["a_term"]] = c.a_term[m]
+            s[g, src0, C["a_prev_idx"]] = c.a_prev_idx[m]
+            s[g, src0, C["a_prev_term"]] = c.a_prev_term[m]
+            s[g, src0, C["a_commit"]] = c.a_commit[m]
+            s[g, src0, C["a_success"]] = c.a_success[m]
+            s[g, src0, C["a_match"]] = c.a_match[m]
             self._stg_a_arr[g, src0] = self._arrival
             seq = c.a_seq[m]
             # Seq is the ReadIndex round binding: only REQ rows may set
@@ -468,6 +549,9 @@ class RaftNode:
         with self._stage_lock:
             self._arrival += 1
             arrival = self._arrival
+            if batch.cols is not None or batch.votes or batch.appends \
+                    or batch.snapshots:
+                self._stage_dirty = True
             if batch.cols is not None:
                 self._stage_cols(src0, batch.cols)
             for v in batch.votes:
@@ -496,31 +580,98 @@ class RaftNode:
                         self._props[pr.group].append(pr.payload)
                         self._prop_len[pr.group] += 1
                         self._fwd_groups.add(pr.group)
+        self._work_evt.set()
 
     # ------------------------------------------------------------------
     # the event loop
 
     def _run(self) -> None:
-        interval = self.cfg.tick_interval_s
-        while not self._stop_evt.is_set():
-            t0 = time.monotonic()
-            try:
-                self.tick()
-            except Exception as e:       # pragma: no cover - defensive
-                log.exception("node %d tick failed", self.node_id)
-                self._on_error(e)
-                return
-            dt = time.monotonic() - t0
-            if dt < interval:
-                time.sleep(interval - dt)
+        """Event-driven loop with step elision.
 
-    def tick(self) -> None:
+        Three kinds of wakeup:
+          - WORK (the _work_evt fires): proposals or peer batches
+            arrived — step immediately, carrying any timer advance
+            accumulated so far (timer_inc = pending).
+          - TIMER (interval elapsed): accumulate one tick of timer
+            advance; only run a step once the accumulated advance
+            reaches the device-reported margin (info.timer_margin — the
+            soonest any election/heartbeat timer could fire).  An idle
+            node therefore steps about once per heartbeat interval, not
+            once per tick interval.
+          - STOP.
+
+        The interval-paced timer advance keeps the reference's
+        real-time raft semantics (100 ms Tick() cadence, raft.go:207);
+        work steps with timer_inc=0 only accelerate message/proposal
+        processing between timer boundaries."""
+        prof_dir = os.environ.get("RAFTSQL_PROFILE")
+        prof = None
+        if prof_dir:                     # tick-thread cProfile (§5.1)
+            import cProfile
+            prof = cProfile.Profile()
+            prof.enable()
+            prof_path = os.path.join(
+                prof_dir, f"raftsql-node{self.node_id}-tick.prof")
+            prof_next = time.monotonic() + 5.0
+        interval = self.cfg.tick_interval_s
+        anchor = time.monotonic()        # last instant pending was credited
+        pending = 1                      # first step advances timers
+        while not self._stop_evt.is_set():
+            if prof is not None and time.monotonic() >= prof_next:
+                prof.disable()
+                prof.dump_stats(prof_path)
+                prof.enable()
+                prof_next = time.monotonic() + 5.0
+            now = time.monotonic()
+            if interval > 0:
+                k = int((now - anchor) / interval)
+                if k > 0:
+                    # Cap at the margin: after a host stall, elapsed
+                    # real time beyond the soonest possible timer fire
+                    # must not replay as a burst of catch-up advances
+                    # (a timer fires at most once per step anyway).
+                    pending = min(pending + k, max(self._timer_margin, 1))
+                    anchor += k * interval
+                    if anchor < now - interval:
+                        anchor = now
+            else:
+                pending = 1              # untimed config: step each loop
+            if self._work_evt.is_set() or pending >= self._timer_margin:
+                # Clear BEFORE the step: work staged after this point
+                # leaves the event set and the wait below returns
+                # immediately; work staged before it is consumed by
+                # this step.
+                self._work_evt.clear()
+                try:
+                    self.tick(timer_inc=pending)
+                except Exception as e:   # pragma: no cover - defensive
+                    log.exception("node %d tick failed", self.node_id)
+                    self._on_error(e)
+                    return
+                pending = 0
+            # Sleep until the accumulated advance could reach the margin
+            # (one heartbeat/election horizon away), or work arrives.
+            need = max(self._timer_margin - pending, 1)
+            wait = (anchor + need * interval) - time.monotonic()
+            if wait > 0:
+                self._work_evt.wait(wait)
+
+    def tick(self, advance_timers: bool = True,
+             timer_inc: Optional[int] = None) -> None:
         """One full consensus tick: stage → step → WAL → send → publish.
+
+        `timer_inc` is how many tick intervals of election/heartbeat
+        timer advance this step applies (see core/step.py); the event
+        loop passes its accumulated count.  The boolean shorthand
+        `advance_timers` (used by tests and direct drivers) means
+        timer_inc=1/0.
 
         Each phase's wall time accumulates into NodeMetrics (exported via
         GET /metrics as per-tick averages — SURVEY.md §5.1's live-runtime
         profiling), so a slow tick localizes to device step vs WAL fsync
         vs transport vs publish without a profiler attached."""
+        if timer_inc is None:
+            timer_inc = 1 if advance_timers else 0
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
         m = self.metrics
@@ -538,10 +689,20 @@ class RaftNode:
         t0 = time.monotonic()
         m.t_stage_ms += (t0 - ts) * 1e3
 
-        state, outbox, info = peer_step_jit(
-            cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr)
+        fb = self._force_bcast
+        if fb:
+            self._force_bcast = False
+        state, pob, pinfo, nidx, margin = peer_step_packed(
+            cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr,
+            self._ti_arr[timer_inc] if timer_inc <= 1
+            else jnp.asarray(timer_inc, jnp.int32),
+            self._fb_arr[fb])
         self.state = state
-        outbox, info = jax.device_get((outbox, info))
+        pob, pinfo, nidx, margin = jax.device_get(
+            (pob, pinfo, nidx, margin))
+        outbox = _view_outbox(pob)
+        info = _view_info(pinfo, nidx)
+        self._timer_margin = max(int(margin), 1)
         t1 = time.monotonic()
 
         with self._wal_lock:
@@ -563,6 +724,15 @@ class RaftNode:
         self._last_hint = np.asarray(info.leader_hint)
         self._tick_no += 1
         m.ticks += 1
+        # Re-arm the loop when a leader still has proposal backlog past
+        # the per-step E cap (progress was made, more to drain now); a
+        # leaderless backlog must NOT spin — it drains once election
+        # timers (interval-paced) produce a leader.
+        if int(np.asarray(info.prop_accepted).sum()) > 0:
+            with self._prop_lock:
+                leftover = int(self._prop_len.sum()) > 0
+            if leftover:
+                self._work_evt.set()
 
     # -- tick phases -----------------------------------------------------
 
@@ -652,35 +822,47 @@ class RaftNode:
                      self.node_id, g, rec.last_idx)
 
     def _build_inbox(self):
+        """Drain staging into ONE packed [G, P, IB_NCOLS+E] device array
+        (core/step.py unpack_inbox).  Clean steps (nothing staged since
+        the last build) reuse the prebuilt all-zero device buffer — the
+        inbox is never donated, so the same buffers serve every clean
+        step and the build costs nothing."""
         cfg = self.cfg
-        G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
-        a_n = np.zeros((G, P), np.int32)
-        a_ents = np.zeros((G, P, E), np.int32)
+        E = cfg.max_entries_per_msg
+        C = self._COL
         with self._stage_lock:
+            clean = not self._stage_dirty
+        if clean:
+            if self._zero_inbox is None:
+                G, P = cfg.num_groups, self.num_nodes
+                self._zero_inbox = jnp.zeros((G, P, IB_NCOLS + E),
+                                             jnp.int32)
+            self._tick_seq = self._zero_seq
+            return self._zero_inbox, {}
+        with self._stage_lock:
+            self._stage_dirty = False
             votes, apps = self._stage_votes, self._stage_apps
             app_arr = self._stage_app_arr
             self._stage_votes, self._stage_apps = {}, {}
             self._stage_app_arr = {}
-            # Columnar staging becomes the inbox base (no copy — fresh
-            # arrays replace them for the next window); the record dicts
-            # overlay it below.  Columnar appends are always n == 0.
+            # The packed columnar staging buffer becomes the inbox base
+            # (no copy — a fresh buffer replaces it for the next window);
+            # the record dicts overlay it below.  Ownership transfers
+            # here: after this drain only this thread touches `stg`, so
+            # the single jnp.asarray below can never race a concurrent
+            # _deliver scatter.  Columnar appends are always n == 0.
             stg = self._stg
             seq_arr = self._stg_a_seq
             col_arr = self._stg_a_arr
             self._stg = self._fresh_stage_cols()
             self._stg_a_seq = np.zeros_like(seq_arr)
             self._stg_a_arr = np.zeros_like(col_arr)
-        v_type, v_term = stg["v_type"], stg["v_term"]
-        v_li, v_lt, v_gr = stg["v_last_idx"], stg["v_last_term"], \
-            stg["v_granted"].astype(bool)
-        a_type, a_term = stg["a_type"], stg["a_term"]
-        a_pi, a_pt = stg["a_prev_idx"], stg["a_prev_term"]
-        a_cm, a_ma = stg["a_commit"], stg["a_match"]
-        a_su = stg["a_success"].astype(bool)
         for (g, s), v in votes.items():
-            v_type[g, s], v_term[g, s] = v.type, v.term
-            v_li[g, s], v_lt[g, s] = v.last_idx, v.last_term
-            v_gr[g, s] = v.granted
+            stg[g, s, C["v_type"]] = v.type
+            stg[g, s, C["v_term"]] = v.term
+            stg[g, s, C["v_last_idx"]] = v.last_idx
+            stg[g, s, C["v_last_term"]] = v.last_term
+            stg[g, s, C["v_granted"]] = v.granted
         stale: List[Tuple[int, int]] = []
         for (g, s), a in apps.items():
             if app_arr.get((g, s), 0) < col_arr[g, s]:
@@ -690,11 +872,15 @@ class RaftNode:
                 # response would also mis-bind the seq echo below.)
                 stale.append((g, s))
                 continue
-            a_type[g, s], a_term[g, s] = a.type, a.term
-            a_pi[g, s], a_pt[g, s] = a.prev_idx, a.prev_term
-            a_n[g, s], a_cm[g, s] = a.n, a.commit
-            a_su[g, s], a_ma[g, s] = a.success, a.match
-            a_ents[g, s, :a.n] = a.ent_terms[:E]
+            stg[g, s, C["a_type"]] = a.type
+            stg[g, s, C["a_term"]] = a.term
+            stg[g, s, C["a_prev_idx"]] = a.prev_idx
+            stg[g, s, C["a_prev_term"]] = a.prev_term
+            stg[g, s, C["a_n"]] = a.n
+            stg[g, s, C["a_commit"]] = a.commit
+            stg[g, s, C["a_success"]] = a.success
+            stg[g, s, C["a_match"]] = a.match
+            stg[g, s, IB_NCOLS:IB_NCOLS + a.n] = a.ent_terms[:a.n]
             if a.type == MSG_REQ:
                 # Bind the seq echo to the request the device will
                 # actually process (the record overlays the columnar
@@ -702,17 +888,8 @@ class RaftNode:
                 seq_arr[g, s] = a.seq
         for k in stale:
             del apps[k]
-        inbox = Inbox(
-            v_type=jnp.asarray(v_type), v_term=jnp.asarray(v_term),
-            v_last_idx=jnp.asarray(v_li), v_last_term=jnp.asarray(v_lt),
-            v_granted=jnp.asarray(v_gr),
-            a_type=jnp.asarray(a_type), a_term=jnp.asarray(a_term),
-            a_prev_idx=jnp.asarray(a_pi), a_prev_term=jnp.asarray(a_pt),
-            a_n=jnp.asarray(a_n), a_ents=jnp.asarray(a_ents),
-            a_commit=jnp.asarray(a_cm), a_success=jnp.asarray(a_su),
-            a_match=jnp.asarray(a_ma))
         self._tick_seq = seq_arr
-        return inbox, apps
+        return jnp.asarray(stg), apps
 
     def _wal_phase(self, info) -> None:
         """Persist this tick's appends + hard-state changes, one fsync.
@@ -1144,7 +1321,7 @@ class RaftNode:
                 # CONSUMER thread (runtime/db.py _expand_commit_item),
                 # off the tick's critical path.  All-empty ranges
                 # (no-op/conf entries) publish nothing, as before.
-                self.commit_q.put((g, a, datas))
+                self.commit_q.put((RAW_BATCH, g, a, datas))
             self._applied[g] = c
             self.metrics.commits += c - a
             if self._local[g]:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import sys
 
 
 def _pin_platform_from_env() -> None:
@@ -45,13 +46,27 @@ from raftsql_tpu.transport.tcp import TcpTransport
 
 
 def build_node(cluster: str, node_id: int, groups: int = 1,
-               tick: float = 0.01, election_ticks: int = 10,
+               tick: float = 0.01, election_ticks: int | None = None,
                data_prefix: str = "raftsql", resume: bool = False,
                compact_every: int = 0, compact_keep: int = 1024,
                wal_segment_bytes: int = 4 << 20) -> RaftDB:
     peers = cluster.split(",")
+    # Default election/heartbeat timing is REAL-TIME parity with the
+    # reference (~1 s election timeout, ~100 ms heartbeat at its 100 ms
+    # tick — raft.go:154-155, 207), whatever the tick interval: timers
+    # advance only on interval-paced steps (core/step.py timer_inc), so
+    # a fast tick must mean "fine timer resolution", not "20x shorter
+    # election timeout".  A 5 ms tick with the raw 10-tick default gave
+    # a 50-100 ms election window — OS scheduling jitter alone fired
+    # constant spurious elections under load.
+    if election_ticks is None:
+        election_ticks = max(10, round(1.0 / tick))
+    heartbeat_ticks = max(1, round(0.1 / tick))
+    if election_ticks <= 2 * heartbeat_ticks:
+        heartbeat_ticks = max(1, election_ticks // 3)
     cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
                      tick_interval_s=tick, election_ticks=election_ticks,
+                     heartbeat_ticks=heartbeat_ticks,
                      wal_segment_bytes=wal_segment_bytes)
     transport = TcpTransport(peers, node_id - 1)
     pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
@@ -93,10 +108,22 @@ def main(argv=None) -> None:
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
+    # The serving process is ~30 cooperating threads (tick loop, HTTP
+    # handlers, commit consumer, transport); CPython's default 5 ms GIL
+    # switch interval makes every cross-thread handoff on the
+    # propose→commit→ack path cost up to 5 ms × runnable threads.  1 ms
+    # trades a little throughput for a large latency cut on small hosts.
+    sys.setswitchinterval(
+        float(os.environ.get("RAFTSQL_GIL_SWITCH_S", "0.001")))
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+    # RAFTSQL_PROFILE=<dir>: cProfile of the consensus tick thread,
+    # dumped periodically to <dir>/raftsql-node<id>-tick.prof
+    # (runtime/node.py _run; SURVEY.md §5.1 — host-side profiling of
+    # the serving process, the complement of the JAX profiler's device
+    # traces in bench.py).
     rdb = build_node(args.cluster, args.id, groups=args.groups,
                      tick=args.tick, resume=args.resume,
                      compact_every=args.compact_every,

@@ -255,12 +255,14 @@ class WAL:
         n = len(groups)
         if n == 0:
             return
-        # One dict store per record (ascending-per-group makes last wins
-        # == max); the per-record bump() get+compare was ~10% of the
-        # saturated WAL phase.
+        # One dict op per record; max-per-group without trusting callers
+        # to keep the documented ascending order (the per-record bump()
+        # get+compare was ~10% of the saturated WAL phase).
         last: Dict[int, int] = {}
+        get = last.get
         for g, i in zip(groups, indexes):
-            last[g] = i
+            if i > get(g, -1):
+                last[g] = i
         bump = self._active_stats.bump
         for g, i in last.items():
             bump(g, i)

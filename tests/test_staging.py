@@ -15,9 +15,17 @@ import numpy as np
 import pytest
 
 from raftsql_tpu.config import MSG_REQ, MSG_RESP, RaftConfig
+from raftsql_tpu.core.step import unpack_inbox
 from raftsql_tpu.runtime.node import RaftNode
 from raftsql_tpu.transport.base import AppendRec, ColRecs, TickBatch
 from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+
+
+def build_inbox(node):
+    """node._build_inbox(), unpacked to the named Inbox view (the build
+    returns the packed [G, P, IB_NCOLS+E] array — core/step.py)."""
+    packed, apps = node._build_inbox()
+    return unpack_inbox(packed), apps
 
 
 @pytest.fixture
@@ -67,7 +75,7 @@ def test_record_req_then_columnar_resp_resp_wins(node):
     src = 2  # node_id 2 -> slot 1
     node._deliver(src, TickBatch(appends=[rec_req(0, seq=5)]))
     node._deliver(src, TickBatch(cols=col_resp(0, seq=999)))
-    inbox, apps = node._build_inbox()
+    inbox, apps = build_inbox(node)
     assert int(np.asarray(inbox.a_type)[0, 1]) == MSG_RESP
     # The displaced record is gone from the WAL-phase dict too.
     assert (0, 1) not in apps
@@ -81,7 +89,7 @@ def test_columnar_resp_then_record_req_req_and_its_seq_win(node):
     src = 2
     node._deliver(src, TickBatch(cols=col_resp(0, seq=999)))
     node._deliver(src, TickBatch(appends=[rec_req(0, seq=5)]))
-    inbox, apps = node._build_inbox()
+    inbox, apps = build_inbox(node)
     assert int(np.asarray(inbox.a_type)[0, 1]) == MSG_REQ
     assert (0, 1) in apps
     assert int(node._tick_seq[0, 1]) == 5
@@ -94,13 +102,13 @@ def test_columnar_resp_seq_never_enters_echo_array(node):
     """A columnar RESP alone must leave the seq-echo array untouched:
     only REQ rows may set the echo binding."""
     node._deliver(2, TickBatch(cols=col_resp(1, seq=4242)))
-    node._build_inbox()
+    build_inbox(node)
     assert int(node._tick_seq[1, 1]) == 0
 
 
 def test_columnar_req_seq_binds(node):
     node._deliver(2, TickBatch(cols=col_req(1, seq=17)))
-    inbox, _ = node._build_inbox()
+    inbox, _ = build_inbox(node)
     assert int(np.asarray(inbox.a_type)[1, 1]) == MSG_REQ
     assert int(node._tick_seq[1, 1]) == 17
 
@@ -111,7 +119,7 @@ def test_record_req_then_newer_columnar_heartbeat_wins(node):
     src = 3  # slot 2
     node._deliver(src, TickBatch(appends=[rec_req(0, seq=5)]))
     node._deliver(src, TickBatch(cols=col_req(0, seq=6)))
-    inbox, apps = node._build_inbox()
+    inbox, apps = build_inbox(node)
     assert int(np.asarray(inbox.a_type)[0, 2]) == MSG_REQ
     assert int(np.asarray(inbox.a_n)[0, 2]) == 0      # heartbeat, no ents
     assert (0, 2) not in apps
@@ -120,8 +128,8 @@ def test_record_req_then_newer_columnar_heartbeat_wins(node):
 
 def test_windows_reset_between_ticks(node):
     node._deliver(2, TickBatch(cols=col_req(0, seq=17)))
-    node._build_inbox()
-    inbox, apps = node._build_inbox()
+    build_inbox(node)
+    inbox, apps = build_inbox(node)
     assert int(np.asarray(inbox.a_type)[0, 1]) == 0
     assert not apps
     assert int(node._tick_seq[0, 1]) == 0
