@@ -95,6 +95,10 @@ class FusedClusterNode:
         self._queued: set = set()            # (peer, group) with backlog
         self._hints = np.full(G, -1, np.int64)
         self._tick_no = 0
+        # Last tick's packed info, published at the START of the next
+        # tick (overlapped with the device dispatch) — its entries are
+        # already durable by then.
+        self._pending_pinfo: Optional[np.ndarray] = None
 
         states = []
         for p in range(P):
@@ -198,11 +202,13 @@ class FusedClusterNode:
     def tick(self) -> None:
         """One fused step + the durable host phase.
 
-        Order within the tick (the contract in the module docstring):
-        dispatch → read packed info → mirror-reads → WAL/payload writes
-        → fsync (all peers) → publish.  The NEXT dispatch cannot happen
-        before this method returns, so every message composed this tick
-        is durable on its sender before any receiver observes it.
+        Order (the contract in the module docstring): dispatch → (while
+        the device runs: publish the PREVIOUS tick's commits — they are
+        already durable) → read packed info → mirror-reads → WAL +
+        payload-log writes → fsync every peer.  The NEXT dispatch cannot
+        happen before this method returns, so every message composed
+        this tick is durable on its sender before any receiver observes
+        it; publish always runs after the save of the tick it publishes.
         """
         import time as _t
         cfg = self.cfg
@@ -210,11 +216,17 @@ class FusedClusterNode:
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n()
-        self.states, self.inboxes, pinfo = cluster_step_host(
+        self.states, self.inboxes, pinfo_dev = cluster_step_host(
             cfg, self.states, self.inboxes, jnp.asarray(prop_n))
-        pinfo = np.asarray(jax.device_get(pinfo))     # [P, G, NCOLS]
         t1 = _t.monotonic()
-        self.metrics.t_device_ms += (t1 - t0) * 1e3
+        # Overlap: tick t-1's commits are durable (fsynced last tick);
+        # deliver them to the apply plane while the device computes.
+        if self._pending_pinfo is not None:
+            self._publish(self._pending_pinfo)
+            self._pending_pinfo = None
+        t2 = _t.monotonic()
+        pinfo = np.asarray(jax.device_get(pinfo_dev))     # [P, G, NCOLS]
+        t3 = _t.monotonic()
 
         self._hints = pinfo[0, :, _C["leader_hint"]]
 
@@ -234,66 +246,113 @@ class FusedClusterNode:
                 mirrors.append((p, g, start, new_len, ents))
 
         # Phase 2: WAL + payload-log writes, then one fsync per peer.
+        # Record building is vectorized: per-entry group/index/term
+        # columns come from numpy repeat/arange over the per-group
+        # counts; Python touches each GROUP once, each entry's bytes
+        # ride list extends.
         for p in range(P):
             col = pinfo[p]
-            w_g: List[int] = []
-            w_i: List[int] = []
-            w_t: List[int] = []
-            w_d: List[bytes] = []
             noop = col[:, _C["noop"]]
             acc = col[:, _C["prop_accepted"]]
-            lead_active = np.nonzero((noop != 0) | (acc > 0))[0]
-            for g in lead_active.tolist():
-                base = int(col[g, _C["prop_base"]])
-                term = int(col[g, _C["term"]])
-                if noop[g]:
-                    w_g.append(g)
-                    w_i.append(base)
-                    w_t.append(term)
-                    w_d.append(b"")
-                    self.plogs[p].put(g, base, [b""], [term])
-                n = int(acc[g])
-                if n:
+            base = col[:, _C["prop_base"]]
+            term = col[:, _C["term"]]
+            parts_g: List[np.ndarray] = []
+            parts_i: List[np.ndarray] = []
+            parts_t: List[np.ndarray] = []
+            w_d: List[bytes] = []
+            puts: List[tuple] = []
+            ngs = np.nonzero(noop)[0]
+            if ngs.size:
+                # Fresh-leader no-ops: one empty record at prop_base
+                # (ordered before any accepted proposals of the same
+                # group — base < base+1, both pure tail appends).
+                parts_g.append(ngs)
+                parts_i.append(base[ngs])
+                parts_t.append(term[ngs])
+                w_d.extend([b""] * ngs.size)
+                for g in ngs.tolist():
+                    puts.append((g, int(base[g]), [b""],
+                                 [int(term[g])], None))
+            ags = np.nonzero(acc > 0)[0]
+            if ags.size:
+                counts = acc[ags]
+                starts = base[ags] + 1
+                tot = int(counts.sum())
+                offs = np.cumsum(counts) - counts
+                parts_g.append(np.repeat(ags, counts))
+                parts_i.append(np.arange(tot)
+                               - np.repeat(offs, counts)
+                               + np.repeat(starts, counts))
+                parts_t.append(np.repeat(term[ags], counts))
+                for g in ags.tolist():
+                    n = int(acc[g])
                     q = self._props[p][g]
                     batch = [q.popleft() for _ in range(n)]
-                    w_g.extend([g] * n)
-                    w_i.extend(range(base + 1, base + 1 + n))
-                    w_t.extend([term] * n)
                     w_d.extend(batch)
-                    self.plogs[p].put(g, base + 1, batch, [term] * n)
-                    self.metrics.proposals += n
-        # Mirrors write AFTER all leader tail-appends of this tick are
-        # in — a (deposed-leader, fresh-follower) peer could otherwise
-        # interleave, but mirror content was already read in phase 1
-        # so ordering here only affects which write wins the suffix:
-        # the device's accept decision (the mirror) must win.
+                    puts.append((g, int(base[g]) + 1, batch,
+                                 [int(term[g])] * n, None))
+                self.metrics.proposals += tot
+            # Mirrors last: their content was read in phase 1, so order
+            # only decides which write wins a conflicting suffix — the
+            # device's accept decision (the mirror) must win.  An
+            # empty-ents mirror still carries its new_len truncation.
+            # Python collects per-GROUP lists; the per-entry columns are
+            # one repeat/arange construction at the end (per-group numpy
+            # allocs lost to plain list extends at E-sized blocks).
+            m_g: List[int] = []
+            m_start: List[int] = []
+            m_count: List[int] = []
+            m_terms: List[int] = []
             for (mp, g, start, new_len, ents) in mirrors:
                 if mp != p:
                     continue
                 terms = [t for (t, _) in ents]
                 datas = [d for (_, d) in ents]
-                self.plogs[p].put(g, start, datas, terms,
-                                  new_len=new_len)
-                w_g.extend([g] * len(ents))
-                w_i.extend(range(start, start + len(ents)))
-                w_t.extend(terms)
-                w_d.extend(datas)
-            hs = np.stack([col[:, _C["term"]], col[:, _C["voted_for"]],
+                if ents:
+                    m_g.append(g)
+                    m_start.append(start)
+                    m_count.append(len(ents))
+                    m_terms.extend(terms)
+                    w_d.extend(datas)
+                puts.append((g, start, datas, terms, new_len))
+            if m_g:
+                counts = np.asarray(m_count)
+                starts = np.asarray(m_start)
+                tot = int(counts.sum())
+                offs = np.cumsum(counts) - counts
+                parts_g.append(np.repeat(np.asarray(m_g), counts))
+                parts_i.append(np.arange(tot)
+                               - np.repeat(offs, counts)
+                               + np.repeat(starts, counts))
+                parts_t.append(np.asarray(m_terms))
+            if puts:
+                self.plogs[p].put_ranges(puts)
+            hs = np.stack([term, col[:, _C["voted_for"]],
                            col[:, _C["commit"]]], axis=1)
             changed = np.nonzero((hs != self._hard[p]).any(axis=1))[0]
-            if w_g:
-                self.wals[p].append_entries(w_g, w_i, w_t, w_d)
+            if parts_g:
+                self.wals[p].append_entries(np.concatenate(parts_g),
+                                            np.concatenate(parts_i),
+                                            np.concatenate(parts_t),
+                                            w_d)
             if changed.size:
                 self.wals[p].set_hardstates(changed, hs[changed, 0],
                                             hs[changed, 1],
                                             hs[changed, 2])
                 self._hard[p][changed] = hs[changed]
             self.wals[p].sync()          # the durable barrier, per peer
-        t2 = _t.monotonic()
-        self.metrics.t_wal_ms += (t2 - t1) * 1e3
+        t4 = _t.monotonic()
+        self._pending_pinfo = pinfo
+        self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2)) * 1e3
+        self.metrics.t_publish_ms += (t2 - t1) * 1e3
+        self.metrics.t_wal_ms += (t4 - t3) * 1e3
+        self._tick_no += 1
+        self.metrics.ticks += 1
 
-        # Phase 3: publish (after save, before the next dispatch).
-        for p in range(P):
+    def _publish(self, pinfo: np.ndarray) -> None:
+        """Deliver a saved tick's newly committed entries to each peer's
+        commit stream (they were fsynced before this runs)."""
+        for p in range(self.cfg.num_peers):
             col = pinfo[p]
             commit = col[:, _C["commit"]]
             ready = np.nonzero(commit > self._applied[p])[0]
@@ -310,14 +369,13 @@ class FusedClusterNode:
                 self._applied[p][g] = c
                 if p == 0:
                     self.metrics.commits += c - a
-        t3 = _t.monotonic()
-        self.metrics.t_publish_ms += (t3 - t2) * 1e3
-        self._tick_no += 1
-        self.metrics.ticks += 1
 
     # -- teardown -------------------------------------------------------
 
     def stop(self) -> None:
+        if self._pending_pinfo is not None:
+            self._publish(self._pending_pinfo)    # already durable
+            self._pending_pinfo = None
         for w in self.wals:
             w.close()
         for q in self._commit_qs:

@@ -619,9 +619,14 @@ class RaftNode:
         while not self._stop_evt.is_set():
             if prof is not None and time.monotonic() >= prof_next:
                 prof.disable()
-                prof.dump_stats(prof_path)
-                prof.enable()
-                prof_next = time.monotonic() + 5.0
+                try:
+                    prof.dump_stats(prof_path)
+                except OSError as e:   # diagnostics must not kill ticks
+                    log.warning("profile dump failed: %s", e)
+                    prof = None
+                else:
+                    prof.enable()
+                    prof_next = time.monotonic() + 5.0
             now = time.monotonic()
             if interval > 0:
                 k = int((now - anchor) / interval)
