@@ -898,6 +898,12 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         active = min(active, groups)
         best = 0.0
         for _ in range(repeats):
+            # Flush the previous repeat's in-flight tail (publish is
+            # deferred one tick, commits lag ~3) so it cannot leak into
+            # this repeat's timed window.
+            for _ in range(6):
+                node.tick()
+                drain(node, apply=False)
             cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
             for g in range(active):
                 node.propose_many(g, cmds)

@@ -176,6 +176,30 @@ def make_sharded_cluster_step(cfg: RaftConfig, mesh: Mesh):
     return jax.jit(mapped, donate_argnums=(0, 1))
 
 
+def make_sharded_cluster_step_host(cfg: RaftConfig, mesh: Mesh):
+    """The sharded tick with single-array host info, for the durable
+    mesh runtime (runtime/fused.py MeshClusterNode): same SPMD program
+    as `make_sharded_cluster_step`, but StepInfo crosses the host
+    boundary as ONE packed [P, G, INFO_NCOLS] i32 array (core/step.py
+    pack_info) — the host plane (WAL, payload mirroring, publish)
+    consumes identical columns whether the cluster runs fused on one
+    chip or sharded over the mesh."""
+    from raftsql_tpu.core.step import pack_info
+
+    step = make_sharded_step_fn(cfg, mesh)
+
+    def _step(states, inboxes, prop_n):
+        states, delivered, infos = step(states, inboxes, prop_n)
+        return states, delivered, jax.vmap(pack_info)(infos)
+
+    mapped = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(state_specs(), inbox_specs(), _spec2()),
+        out_specs=(state_specs(), inbox_specs(),
+                   P(PEERS_AXIS, GROUPS_AXIS, None)))
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
 def make_sharded_cluster_run(cfg: RaftConfig, mesh: Mesh, num_ticks: int):
     """Compile a `num_ticks`-tick scan of the sharded step (device-resident).
 

@@ -199,6 +199,14 @@ class FusedClusterNode:
             self._queued.discard(k)
         return prop_n
 
+    def _device_step(self, prop_n: np.ndarray):
+        """Dispatch one cluster step; returns the packed-info device
+        array.  MeshClusterNode overrides this with the shard_map'd
+        step — the durable host plane below is identical either way."""
+        self.states, self.inboxes, pinfo_dev = cluster_step_host(
+            self.cfg, self.states, self.inboxes, jnp.asarray(prop_n))
+        return pinfo_dev
+
     def tick(self) -> None:
         """One fused step + the durable host phase.
 
@@ -216,8 +224,7 @@ class FusedClusterNode:
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n()
-        self.states, self.inboxes, pinfo_dev = cluster_step_host(
-            cfg, self.states, self.inboxes, jnp.asarray(prop_n))
+        pinfo_dev = self._device_step(prop_n)
         t1 = _t.monotonic()
         # Overlap: tick t-1's commits are durable (fsynced last tick);
         # deliver them to the apply plane while the device computes.
@@ -386,3 +393,41 @@ class FusedClusterNode:
     def roles(self) -> np.ndarray:
         """[P, G] role matrix from the live device state."""
         return np.asarray(self.states.role)
+
+
+class MeshClusterNode(FusedClusterNode):
+    """The durable runtime SPMD over a multi-chip mesh.
+
+    Same host plane as FusedClusterNode — per-peer WALs, payload
+    mirroring, fsync-before-next-dispatch, deferred publish — but the
+    device step is the shard_map'd cluster tick
+    (parallel/sharded.py): the group axis shards data-parallel (zero
+    collectives), the peer axis shards with the message exchange riding
+    `all_to_all` over ICI.  This is the multi-chip analog of the
+    reference's transport integration (reference raft.go:230): what was
+    rafthttp between processes is a collective between chips, and the
+    durable ordering argument is unchanged because the host still
+    interposes every peer's WAL fsync between dispatches.
+
+    Payload note: one host process drives the whole mesh (the
+    single-controller model), so payload mirroring between peers stays
+    a host-memory copy exactly as in the fused runtime — only consensus
+    math and message metadata ride the mesh.
+    """
+
+    def __init__(self, cfg: RaftConfig, data_dir: str, mesh,
+                 seed: Optional[int] = None):
+        from raftsql_tpu.parallel.sharded import (
+            make_sharded_cluster_step_host, shard_cluster_arrays)
+        super().__init__(cfg, data_dir, seed)
+        self.mesh = mesh
+        self._sharded_step = make_sharded_cluster_step_host(cfg, mesh)
+        # Lay the freshly built (or replayed) cluster state out over the
+        # mesh; subsequent steps keep the sharding (donated in/out).
+        self.states, self.inboxes = shard_cluster_arrays(
+            mesh, self.states, self.inboxes)
+
+    def _device_step(self, prop_n: np.ndarray):
+        self.states, self.inboxes, pinfo_dev = self._sharded_step(
+            self.states, self.inboxes, jnp.asarray(prop_n))
+        return pinfo_dev

@@ -641,7 +641,8 @@ class RaftNode:
                         anchor = now
             else:
                 pending = 1              # untimed config: step each loop
-            if self._work_evt.is_set() or pending >= self._timer_margin:
+            if self._work_evt.is_set() or pending >= self._timer_margin \
+                    or interval <= 0:
                 # Clear BEFORE the step: work staged after this point
                 # leaves the event set and the wait below returns
                 # immediately; work staged before it is consumed by

@@ -59,11 +59,17 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
     # election timeout".  A 5 ms tick with the raw 10-tick default gave
     # a 50-100 ms election window — OS scheduling jitter alone fired
     # constant spurious elections under load.
-    if election_ticks is None:
-        election_ticks = max(10, round(1.0 / tick))
-    heartbeat_ticks = max(1, round(0.1 / tick))
-    if election_ticks <= 2 * heartbeat_ticks:
-        heartbeat_ticks = max(1, election_ticks // 3)
+    if tick > 0:
+        if election_ticks is None:
+            election_ticks = max(10, round(1.0 / tick))
+        heartbeat_ticks = max(1, round(0.1 / tick))
+        if election_ticks <= 2 * heartbeat_ticks:
+            heartbeat_ticks = max(1, election_ticks // 3)
+    else:
+        # Untimed (tick <= 0: step-per-loop): real-time scaling is
+        # meaningless — keep the reference's tick counts (raft.go:154-155).
+        election_ticks = election_ticks or 10
+        heartbeat_ticks = 1
     cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
                      tick_interval_s=tick, election_ticks=election_ticks,
                      heartbeat_ticks=heartbeat_ticks,

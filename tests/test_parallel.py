@@ -163,3 +163,52 @@ def test_mesh_divisibility_validation():
     cfg = cfg_for(4, 6)
     with pytest.raises(ValueError, match="not divisible"):
         make_sharded_cluster_step(cfg, mesh)
+
+
+def test_mesh_cluster_node_durable(tmp_path):
+    """MeshClusterNode: the sharded step under the full durable host
+    plane (per-peer WAL, mirroring, publish, apply) — commits flow,
+    every peer's WAL is written, and a restart replays them over the
+    same mesh (VERDICT r4 task 5 / SURVEY §7 phase 4)."""
+    from raftsql_tpu.runtime.db import _expand_commit_item
+    from raftsql_tpu.runtime.fused import MeshClusterNode
+
+    cfg = RaftConfig(num_groups=8, num_peers=4, log_window=32,
+                     max_entries_per_msg=4, tick_interval_s=0.0)
+    mesh = make_mesh(2, 4)
+
+    def drain(node, peer=0):
+        out = []
+        q = node.commit_q(peer)
+        while True:
+            try:
+                item = q.get_nowait()
+            except Exception:
+                break
+            if item is None or not isinstance(item, tuple):
+                continue
+            out.extend(_expand_commit_item(item))
+        return out
+
+    node = MeshClusterNode(cfg, str(tmp_path), mesh)
+    for t in range(200):
+        node.tick()
+        if t > 10 and (node._hints >= 0).all():
+            break
+    assert (node._hints >= 0).all()
+    for g in range(8):
+        node.propose_many(g, [f"SET k{i} g{g}".encode() for i in range(5)])
+    for _ in range(40):
+        node.tick()
+    live = drain(node)
+    assert len(live) == 8 * 5
+    node.stop()
+    # Every peer's WAL dir holds segments (durability actually happened).
+    for p in range(4):
+        segs = list((tmp_path / f"p{p + 1}").iterdir())
+        assert segs, f"peer {p} wrote no WAL"
+
+    node2 = MeshClusterNode(cfg, str(tmp_path), mesh)
+    rep = drain(node2)
+    assert sorted(rep) == sorted(live)
+    node2.stop()
