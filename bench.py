@@ -99,8 +99,12 @@ def _ledger_append(record: dict) -> None:
         _log(f"bench: ledger append failed: {e}")
 
 
-def _ledger_last_good() -> dict | None:
-    """Newest TPU entry from the committed ledger, or None."""
+def _ledger_last_matching(shape: dict) -> dict | None:
+    """Newest TPU-platform ledger entry whose (config, groups, e)
+    matches — the comparison point for the >20%-drop regression
+    tripwire.  Matching is on the reported platform ("tpu"), not the
+    raw backend name: the axon tunnel and a direct TPU VM drive the
+    same chip and their numbers are the same series."""
     try:
         with open(TPU_RUNS_PATH) as f:
             lines = f.read().strip().splitlines()
@@ -111,9 +115,16 @@ def _ledger_last_good() -> dict | None:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(rec, dict) and rec.get("platform") == "tpu":
+        if not isinstance(rec, dict) or rec.get("platform") != "tpu":
+            continue
+        if all(rec.get(k, "") == v for k, v in shape.items()):
             return rec
     return None
+
+
+def _ledger_last_good() -> dict | None:
+    """Newest TPU entry from the committed ledger, or None."""
+    return _ledger_last_matching({})
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +244,7 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
 
     best, best_p50, best_p99, best_tick = 0.0, float("inf"), float("inf"), 0.0
     total_committed = 0
+    repeat_rates: list = []
     label = "saturated" if saturate else f"load={load}/group/tick"
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -258,6 +270,7 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
         _log(f"  {committed} commits in {dt:.3f}s -> {rate:,.0f} commits/s "
              f"({rate / groups:,.1f}/group/s); {lat_msg}")
         best = max(best, rate)
+        repeat_rates.append(round(rate, 1))
     if saturate and total_committed == 0:
         raise RuntimeError("benchmark committed nothing — engine stalled")
     if best_p50 < float("inf"):
@@ -271,6 +284,11 @@ def bench_throughput(groups: int, peers: int, ticks: int, repeats: int,
         stats["p50_ms"] = round(best_p50, 3) if got_lat else None
         stats["p99_ms"] = round(best_p99, 3) if got_lat else None
         stats["tick_ms"] = round(best_tick, 4) if got_lat else None
+        stats["repeat_rates"] = repeat_rates
+        if len(repeat_rates) > 1 and max(repeat_rates) > 0:
+            stats["repeat_spread"] = round(
+                (max(repeat_rates) - min(repeat_rates))
+                / max(repeat_rates), 3)
     return best
 
 
@@ -313,6 +331,20 @@ def bench_latency_sweep(groups: int, peers: int, repeats: int) -> dict:
         st: dict = {}
         bench_throughput(g, peers, ticks, repeats, load=ld, stats=st, e=E)
         sweep[label] = st
+    # p50-vs-G curve (VERDICT r4 task 4): sustained (saturating) load at
+    # each rung of BENCH_LAT_CURVE — the scaling story for the <2 ms
+    # target, not just one shape.  Off by default on cpu fallbacks
+    # (costly); the parent's latency child turns it on for the device.
+    curve_spec = os.environ.get("BENCH_LAT_CURVE", "")
+    if curve_spec:
+        curve = {}
+        for g in (int(x) for x in curve_spec.split(",") if x):
+            st = {}
+            _log(f"== latency curve @ G={g} (sat, E={E}) ==")
+            bench_throughput(g, peers, ticks, repeats, stats=st, e=E)
+            curve[str(g)] = {k: st.get(k)
+                             for k in ("p50_ms", "p99_ms", "tick_ms")}
+        sweep["p50_vs_G"] = curve
     return sweep
 
 
@@ -572,6 +604,7 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
             m.t_stage_ms = m.t_device_ms = m.t_wal_ms = 0.0
             m.t_send_ms = m.t_publish_ms = 0.0
         best = 0.0
+        repeat_rates: list = []
         # BENCH_DURABLE_ACTIVE=N: queue load at only the first N groups.
         # The durable tick's Python cost is proportional to ACTIVE groups
         # (vectorized masks give idle groups ~zero work, runtime/node.py
@@ -606,6 +639,7 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
                  f"{rate:,.0f} commits/s ({dt / ticks * 1e3:.2f} ms/tick); "
                  f"phase_ms={m['phase_ms_per_tick']}")
             best = max(best, rate)
+            repeat_rates.append(round(rate, 1))
         phase = nodes[0].metrics.snapshot()["phase_ms_per_tick"]
 
         # -- Latency phase (VERDICT r3 task 3): REAL wall-clock
@@ -662,7 +696,8 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
                  f"{censored} censored")
         return best, {"durable_phase_ms": phase,
                       "durable_tick_ms": round(sum(phase.values()), 3),
-                      "durable_lat": lat_stats}
+                      "durable_lat": lat_stats,
+                      "repeat_rates": repeat_rates}
     finally:
         for n in nodes:
             try:
@@ -897,13 +932,19 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         active = int(os.environ.get("BENCH_DURABLE_ACTIVE", "0")) or groups
         active = min(active, groups)
         best = 0.0
+        repeat_rates: list = []
         for _ in range(repeats):
             # Flush the previous repeat's in-flight tail (publish is
             # deferred one tick, commits lag ~3) so it cannot leak into
-            # this repeat's timed window.
+            # this repeat's timed window — then drop the idle flush
+            # ticks from the phase averages (they would dilute
+            # durable_tick_ms by ~20%).
             for _ in range(6):
                 node.tick()
                 drain(node, apply=False)
+            m = node.metrics
+            m.ticks = 0
+            m.t_device_ms = m.t_wal_ms = m.t_publish_ms = 0.0
             cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
             for g in range(active):
                 node.propose_many(g, cmds)
@@ -918,6 +959,7 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             _log(f"  {committed} fused durable commits in {dt:.3f}s -> "
                  f"{rate:,.0f} commits/s ({dt / ticks * 1e3:.2f} ms/tick)")
             best = max(best, rate)
+            repeat_rates.append(round(rate, 1))
         snap = node.metrics.snapshot()["phase_ms_per_tick"]
         phase = {k: snap[k] for k in ("device", "wal", "publish")}
 
@@ -957,7 +999,8 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         return best, {"durable_mode": "fused",
                       "durable_phase_ms": phase,
                       "durable_tick_ms": round(sum(phase.values()), 3),
-                      "durable_lat": lat_stats}
+                      "durable_lat": lat_stats,
+                      "repeat_rates": repeat_rates}
     finally:
         node.stop()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -965,23 +1008,37 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
 
 def bench_rules_race(groups: int, peers: int, ticks: int, repeats: int
                      ) -> dict:
-    """Race the three commit-advance kernels at the same shape.
+    """Race the three commit-advance kernels, small-P AND large-P.
 
-    VERDICT r2 task 6: `point` (etcd maybeCommit shortcut), `windowed`
-    (masked ring scan) and `pallas` (hand-written kernel) have never been
-    compared compiled; each is its own jit (commit_rule is static config).
+    VERDICT r2 task 6 / r4 task 7: `point` (etcd maybeCommit shortcut),
+    `windowed` (masked ring scan) and `pallas` (hand-written kernel),
+    each its own jit (commit_rule is static config).  The pallas
+    kernel's claimed regime is large peer counts (its O(P^2) comparison
+    network vs XLA's sort, ops/pallas_quorum.py) — so the race runs the
+    requested P and a P=15 shape; the large-P winner is the evidence
+    for (or against) keeping the kernel as the large-P default.
     """
-    out = {}
-    for rule in ("point", "windowed", "pallas"):
-        _log(f"== commit_rule={rule} (G={groups}) ==")
-        try:
-            out[rule] = round(
-                bench_throughput(groups, peers, ticks, repeats,
-                                 commit_rule=rule), 1)
-        except Exception as e:                      # noqa: BLE001
-            _log(f"  commit_rule={rule} FAILED: {type(e).__name__}: {e}")
-            out[rule] = f"fault: {type(e).__name__}"
-    _log(f"rules race: {out}")
+    out: dict = {}
+    shapes = [(f"P{peers}", groups, peers)]
+    big_p = int(os.environ.get("BENCH_RULES_BIG_P", "15"))
+    if big_p > peers:
+        # Same total work scale: G x P stays comparable.
+        shapes.append((f"P{big_p}", max(groups * peers // big_p, 64),
+                       big_p))
+    for label, g, p in shapes:
+        row = {}
+        for rule in ("point", "windowed", "pallas"):
+            _log(f"== commit_rule={rule} (G={g}, P={p}) ==")
+            try:
+                row[rule] = round(
+                    bench_throughput(g, p, ticks, repeats,
+                                     commit_rule=rule), 1)
+            except Exception as e:                  # noqa: BLE001
+                _log(f"  commit_rule={rule} FAILED: "
+                     f"{type(e).__name__}: {e}")
+                row[rule] = f"fault: {type(e).__name__}"
+        out[label] = row
+        _log(f"rules race {label}: {row}")
     return out
 
 
@@ -1027,7 +1084,8 @@ def run_config(config: str, cpu: bool):
         return bench_multichip(ticks, repeats), {}
     if config == "rules":
         out = bench_rules_race(groups, peers, ticks, repeats)
-        vals = [v for v in out.values() if isinstance(v, float)]
+        vals = [v for row in out.values() for v in row.values()
+                if isinstance(v, float)]
         return (max(vals) if vals else 0.0), {"rules": out}
     if config == "latency":
         sweep = bench_latency_sweep(groups, peers, repeats)
@@ -1078,7 +1136,9 @@ def run_config(config: str, cpu: bool):
     stats: dict = {}
     value = bench_throughput(groups, peers, ticks, repeats, stats=stats)
     extras = {"p50_sat_ms": stats.get("p50_ms"),
-              "tick_ms": stats.get("tick_ms")}
+              "tick_ms": stats.get("tick_ms"),
+              "repeat_rates": stats.get("repeat_rates"),
+              "repeat_spread": stats.get("repeat_spread")}
     if os.environ.get("BENCH_SKIP_SWEEP") != "1":
         sweep = bench_latency_sweep(groups, peers, max(1, repeats - 1))
         extras["lat"] = sweep
@@ -1126,6 +1186,33 @@ def child_main() -> None:
         }
     out.update(extras)
     if platform == "tpu":
+        # Regression tripwire (VERDICT r4 task 6): compare against the
+        # ledger's newest same-shape/same-backend entry BEFORE appending
+        # this run.  A >20% drop is flagged in the JSON and on stderr —
+        # round 4's official numbers moved opposite to the claimed wins
+        # and nothing noticed.
+        shape = {"config": config,
+                 "groups": os.environ.get("BENCH_GROUPS", ""),
+                 "e": os.environ.get("BENCH_E", "")}
+        prev = _ledger_last_matching(shape)
+        # Direction-aware: latency's value is p50 ms (lower = better);
+        # everything else is commits/s (higher = better).
+        lower_is_better = config == "latency"
+        regressed = (prev and prev.get("value", 0) > 0
+                     and (value > 1.25 * prev["value"] if lower_is_better
+                          else value < 0.8 * prev["value"]))
+        if regressed:
+            delta = (value / prev["value"] - 1 if lower_is_better
+                     else 1 - value / prev["value"])
+            warn = {"prev_value": prev["value"],
+                    "prev_ts": prev.get("ts"),
+                    "prev_sha": prev.get("git_sha"),
+                    "drop_pct": round(100 * delta, 1)}
+            out["regression_warn"] = warn
+            _log(f"bench: REGRESSION WARNING {config} shape {shape}: "
+                 f"{value:,.1f} is {warn['drop_pct']}% below ledger "
+                 f"{prev['value']:,.1f} ({prev.get('ts')} "
+                 f"@ {prev.get('git_sha')})")
         # Durable evidence (VERDICT r3 task 1): a wedged tunnel at the
         # driver's capture time must never again erase a real TPU run.
         rec = dict(out)
@@ -1320,7 +1407,11 @@ def main() -> None:
         durable_tpu = _attempt(
             "", min(timeout_s, remaining() - fallback_reserve),
             extra_env={"BENCH_CONFIG": "durable",
-                       "BENCH_DURABLE_MODE": "fused"},
+                       "BENCH_DURABLE_MODE": "fused",
+                       # Measured best host shape (bench_logs r5): E=32
+                       # amortizes the per-group tick Python ~1.7x over
+                       # E=8 at identical durability.
+                       "BENCH_E": os.environ.get("BENCH_E", "32")},
             label="durable-tpu-fused")
 
     # -- 3. durable-path child (host runtime measured on cpu).
@@ -1395,7 +1486,9 @@ def main() -> None:
         latc = _attempt(
             "", min(timeout_s, remaining() - fallback_reserve),
             extra_env={"BENCH_CONFIG": "latency", "BENCH_GROUPS": "1024",
-                       "BENCH_REPEATS": "2"},
+                       "BENCH_REPEATS": "2",
+                       "BENCH_LAT_CURVE": os.environ.get(
+                           "BENCH_LAT_CURVE", "1000,10000,100000")},
             label="latency-G1024")
 
     # -- 3c. commit-rule race on the device (point vs windowed vs

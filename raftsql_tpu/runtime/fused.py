@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import os
 import queue
-from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -90,8 +89,11 @@ class FusedClusterNode:
         self._applied = np.zeros((P, G), np.int64)
         self._hard = np.zeros((P, G, 3), np.int64)
         self._hard[:, :, 1] = -1
-        self._props: List[List[deque]] = [
-            [deque() for _ in range(G)] for _ in range(P)]
+        # Per-(peer, group) proposal queues as plain lists: the tick
+        # pops a whole batch with one C-level slice + del, vs a Python
+        # popleft per entry on a deque.
+        self._props: List[List[list]] = [
+            [[] for _ in range(G)] for _ in range(P)]
         self._queued: set = set()            # (peer, group) with backlog
         self._hints = np.full(G, -1, np.int64)
         self._tick_no = 0
@@ -168,7 +170,7 @@ class FusedClusterNode:
     def propose_many(self, group: int, payloads) -> None:
         """Queue payloads at the group's current leader peer (host-side
         routing — all peers share this process; the distributed
-        runtime's forward-over-transport becomes a deque move)."""
+        runtime's forward-over-transport becomes a list move)."""
         p = int(self._hints[group])
         if p < 0:
             p = 0
@@ -294,7 +296,8 @@ class FusedClusterNode:
                 for g in ags.tolist():
                     n = int(acc[g])
                     q = self._props[p][g]
-                    batch = [q.popleft() for _ in range(n)]
+                    batch = q[:n]
+                    del q[:n]
                     w_d.extend(batch)
                     puts.append((g, int(base[g]) + 1, batch,
                                  [int(term[g])] * n, None))
@@ -376,6 +379,43 @@ class FusedClusterNode:
                 self._applied[p][g] = c
                 if p == 0:
                     self.metrics.commits += c - a
+
+    # -- log compaction (SURVEY §5.4) -----------------------------------
+
+    def compact(self, keep: int = 1024) -> bool:
+        """Advance every peer's compaction floor to (applied - keep):
+        payload-log prefixes drop, COMPACT markers land in the WALs, and
+        fully-superseded closed segments unlink (storage/wal.py compact)
+        — the memory-bound story for sustained load (the reference's
+        MemoryStorage grows forever, raft.go:129).
+
+        `keep` is clamped to >= log_window so every index the device
+        ring can still reference stays servable (mirror reads and
+        in-window resends).  The applied cursor gates the floor: only
+        entries already delivered to the apply plane are dropped.
+        """
+        keep = max(keep, self.cfg.log_window)
+        G = self.cfg.num_groups
+        any_changed = False
+        for p in range(self.cfg.num_peers):
+            plog = self.plogs[p]
+            floors: Dict[int, Tuple[int, int]] = {}
+            changed = False
+            for g in range(G):
+                floor = int(self._applied[p][g]) - keep
+                if floor > plog.start(g):
+                    plog.compact(g, floor, plog.term_of(g, floor))
+                    changed = True
+                s = plog.start(g)
+                if s > 0:
+                    floors[g] = (s, plog.term_of(g, s))
+            if changed:
+                hard = {g: tuple(int(x) for x in self._hard[p][g])
+                        for g in range(G)}
+                self.wals[p].compact(floors, hard)
+                self.metrics.compactions += 1
+                any_changed = True
+        return any_changed
 
     # -- teardown -------------------------------------------------------
 

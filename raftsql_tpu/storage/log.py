@@ -13,9 +13,17 @@ positions the device can no longer describe (runtime/node.py catch-up
 path; the reference gets the same from MemoryStorage.Term, which etcd's
 sendAppend consults before falling back to a snapshot).
 
-Like MemoryStorage, growth is unbounded and never compacted — a documented
-limitation shared with the reference; snapshots are the eventual fix for
-both (reference db.go:27-29 declares the same).
+Storage layout is COLUMNAR: parallel per-group term and payload lists,
+not a list of (term, bytes) tuples.  The hot paths — publish slicing
+payloads for every committed range, and the durable tick appending a
+batch per active group — then cost one C-level list slice/extend each,
+with no per-entry tuple construction (measured: the tuple layout's
+put/slice pair was a double-digit share of the fused durable tick).
+
+Like MemoryStorage, growth is unbounded unless compacted (`compact`, fed
+by state-machine snapshots — runtime/db.py / runtime/fused.py); parity
+deployments never compact, same documented limitation as the reference
+(db.go:27-29).
 """
 from __future__ import annotations
 
@@ -31,17 +39,17 @@ class PayloadLog:
     checks at the compaction edge work."""
 
     def __init__(self, num_groups: int):
-        self._logs: List[List[Tuple[int, bytes]]] = [
-            [] for _ in range(num_groups)]
+        self._terms: List[List[int]] = [[] for _ in range(num_groups)]
+        self._datas: List[List[bytes]] = [[] for _ in range(num_groups)]
         self._start: List[int] = [0] * num_groups
         self._start_term: List[int] = [0] * num_groups
         # One lock: readers (publish, catch-up, send) race the compactor,
-        # and a torn (_start, _logs) read would mis-align indexes.
+        # and a torn (_start, lists) read would mis-align indexes.
         self._mu = __import__("threading").RLock()
 
     def length(self, group: int) -> int:
         with self._mu:
-            return self._start[group] + len(self._logs[group])
+            return self._start[group] + len(self._datas[group])
 
     def start(self, group: int) -> int:
         with self._mu:
@@ -51,7 +59,7 @@ class PayloadLog:
         """Initialize the compaction floor on restart (from a WAL
         snapshot marker).  Only valid on an empty group log."""
         with self._mu:
-            assert not self._logs[group]
+            assert not self._datas[group]
             self._start[group] = start
             self._start_term[group] = start_term
 
@@ -60,7 +68,8 @@ class PayloadLog:
         receiver side of InstallSnapshot: history before the snapshot is
         gone, and any suffix predating it may conflict)."""
         with self._mu:
-            self._logs[group].clear()
+            self._terms[group].clear()
+            self._datas[group].clear()
             self._start[group] = start
             self._start_term[group] = start_term
 
@@ -70,13 +79,14 @@ class PayloadLog:
             s = self._start[group]
             if upto <= s:
                 return
-            del self._logs[group][: upto - s]
+            del self._terms[group][: upto - s]
+            del self._datas[group][: upto - s]
             self._start[group] = upto
             self._start_term[group] = boundary_term
 
     def get(self, group: int, index: int) -> bytes:
         with self._mu:
-            return self._logs[group][index - 1 - self._start[group]][1]
+            return self._datas[group][index - 1 - self._start[group]]
 
     def term_of(self, group: int, index: int) -> int:
         """Term of entry `index`; term_of(0) == 0 (the log-start
@@ -89,7 +99,7 @@ class PayloadLog:
                 return self._start_term[group]
             # A negative list index would silently wrap to the tail.
             assert index > s, f"term_of below compaction floor ({index})"
-            return self._logs[group][index - 1 - s][0]
+            return self._terms[group][index - 1 - s]
 
     def try_term_of(self, group: int, index: int) -> Optional[int]:
         """term_of with a floor check instead of an assert: None when
@@ -103,9 +113,9 @@ class PayloadLog:
             s = self._start[group]
             if index == s:
                 return self._start_term[group]
-            if index < s or index > s + len(self._logs[group]):
+            if index < s or index > s + len(self._terms[group]):
                 return None
-            return self._logs[group][index - 1 - s][0]
+            return self._terms[group][index - 1 - s]
 
     def try_tail_with_terms(self, group: int, start: int, n: int):
         """Atomic (prev_term, [(term, payload)...]) for entries
@@ -121,16 +131,18 @@ class PayloadLog:
             elif start - 1 == s0:
                 prev_term = self._start_term[group]
             else:
-                prev_term = self._logs[group][start - 2 - s0][0]
+                prev_term = self._terms[group][start - 2 - s0]
             rel = start - 1 - s0
-            return prev_term, list(self._logs[group][rel: rel + n])
+            return prev_term, list(zip(self._terms[group][rel: rel + n],
+                                       self._datas[group][rel: rel + n]))
 
     def slice(self, group: int, start: int, n: int) -> List[bytes]:
-        """Entry payloads [start, start+n), 1-based."""
+        """Entry payloads [start, start+n), 1-based — one C-level list
+        slice, the publish hot path."""
         with self._mu:
             s = start - 1 - self._start[group]
             assert s >= 0, "slice below compaction floor"
-            return [d for (_, d) in self._logs[group][s: s + n]]
+            return self._datas[group][s: s + n]
 
     def try_slice(self, group: int, start: int, n: int
                   ) -> Optional[List[bytes]]:
@@ -141,14 +153,15 @@ class PayloadLog:
             s = start - 1 - self._start[group]
             if s < 0:
                 return None
-            return [d for (_, d) in self._logs[group][s: s + n]]
+            return self._datas[group][s: s + n]
 
     def slice_with_terms(self, group: int, start: int, n: int
                          ) -> List[Tuple[int, bytes]]:
         with self._mu:
             s = start - 1 - self._start[group]
             assert s >= 0, "slice below compaction floor"
-            return list(self._logs[group][s: s + n])
+            return list(zip(self._terms[group][s: s + n],
+                            self._datas[group][s: s + n]))
 
     def put(self, group: int, start: int, payloads: Sequence[bytes],
             terms: Sequence[int], new_len: Optional[int] = None) -> None:
@@ -170,25 +183,38 @@ class PayloadLog:
 
     def _put_locked(self, group: int, start: int, payloads, terms,
                     new_len: Optional[int]) -> None:
-        log = self._logs[group]
+        tl, dl = self._terms[group], self._datas[group]
         off = self._start[group]
-        if start - 1 - off == len(log):
-            # Pure tail append — the leader/follower hot path (the
-            # per-entry positioned loop below was the single largest
-            # Python cost of the durable WAL phase at saturation).
-            log.extend(zip(terms, payloads))
+        rel = start - 1 - off
+        # The parallel lists corrupt silently if they ever diverge (the
+        # old tuple layout couldn't): refuse mismatched inputs here.
+        assert len(terms) == len(payloads), (len(terms), len(payloads))
+        if rel == len(dl):
+            # Pure tail append — the leader/follower hot path: two
+            # C-level extends, zero per-entry Python.
+            tl.extend(terms)
+            dl.extend(payloads)
         else:
-            for i, (term, data) in enumerate(zip(terms, payloads)):
-                pos = start - 1 + i - off
-                if pos < 0:
-                    continue   # below the compaction floor: immutable
-                if pos < len(log):
-                    log[pos] = (term, data)
-                elif pos == len(log):
-                    log.append((term, data))
-                else:
-                    raise ValueError(
-                        f"payload gap: group {group} idx "
-                        f"{pos + 1 + off} > len {len(log) + off}")
-        if new_len is not None and new_len - off < len(log):
-            del log[max(new_len - off, 0):]
+            n = len(payloads)
+            if rel >= 0 and rel + n <= len(dl):
+                # In-place overwrite (conflict suffix replacement).
+                tl[rel: rel + n] = terms
+                dl[rel: rel + n] = payloads
+            else:
+                for i in range(n):
+                    pos = rel + i
+                    if pos < 0:
+                        continue   # below the compaction floor: immutable
+                    if pos < len(dl):
+                        tl[pos] = terms[i]
+                        dl[pos] = payloads[i]
+                    elif pos == len(dl):
+                        tl.append(terms[i])
+                        dl.append(payloads[i])
+                    else:
+                        raise ValueError(
+                            f"payload gap: group {group} idx "
+                            f"{pos + 1 + off} > len {len(dl) + off}")
+        if new_len is not None and new_len - off < len(dl):
+            del tl[max(new_len - off, 0):]
+            del dl[max(new_len - off, 0):]

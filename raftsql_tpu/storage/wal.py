@@ -272,7 +272,7 @@ class WAL:
         ga = np.asarray(groups, np.uint32)
         ia = np.asarray(indexes, np.uint64)
         ta = np.asarray(terms, np.uint64)
-        la = np.fromiter((len(d) for d in datas), np.uint32, n)
+        la = np.fromiter(map(len, datas), np.uint32, n)
         self._lib.wal_append_entries(
             self._h, n,
             ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),

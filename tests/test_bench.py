@@ -120,3 +120,27 @@ def test_parent_emits_json_when_all_attempts_fail():
     assert out["metric"] == "raft_commits_per_sec"
     assert out["platform"] == "none"
     assert out["value"] == 0.0
+
+
+def test_ledger_regression_tripwire(tmp_path, monkeypatch):
+    """_ledger_last_matching finds the newest same-shape TPU entry so a
+    >20% drop vs the committed record can be flagged (VERDICT r4 task
+    6: round-4's numbers regressed silently)."""
+    import bench
+
+    path = str(tmp_path / "TPU_RUNS.jsonl")
+    monkeypatch.setattr(bench, "TPU_RUNS_PATH", path)
+    shape = {"config": "headline", "groups": "32768", "e": "32"}
+    assert bench._ledger_last_matching(shape) is None
+    bench._ledger_append(dict(shape, platform="tpu", value=100.0,
+                              ts="t1"))
+    bench._ledger_append({"config": "headline", "groups": "1000",
+                          "e": "32", "platform": "tpu", "value": 5.0,
+                          "ts": "t2"})                 # other shape
+    bench._ledger_append(dict(shape, platform="cpu", value=1.0,
+                              ts="t3"))                # wrong platform
+    got = bench._ledger_last_matching(shape)
+    assert got is not None and got["value"] == 100.0
+    bench._ledger_append(dict(shape, platform="tpu", value=250.0,
+                              ts="t4"))
+    assert bench._ledger_last_matching(shape)["value"] == 250.0

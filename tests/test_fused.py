@@ -152,3 +152,46 @@ def test_fused_kv_apply_converges(tmp_path):
         assert sms[0][g]._data == sms[1][g]._data == sms[2][g]._data
         assert sms[0][g]._data["x4"] == f"v{g}.4"
     node.stop()
+
+
+def test_fused_compaction_bounds_log_under_load(tmp_path):
+    """Sustained load + periodic compact(): floors advance, the payload
+    log's retained span stays bounded, and commits keep flowing
+    (VERDICT r4 task 8 — the soak's invariant at test scale)."""
+    cfg = RaftConfig(num_groups=4, num_peers=3, log_window=32,
+                     max_entries_per_msg=8, tick_interval_s=0.0)
+    node = FusedClusterNode(cfg, str(tmp_path))
+    elect(node)
+    for p in range(3):
+        drain(node, p)
+    committed = 0
+    for round_no in range(12):
+        for g in range(4):
+            node.propose_many(g, [b"SET k v"] * 16)
+        for _ in range(4):
+            node.tick()
+        committed += len(drain(node, 0)[0])
+        node.compact(keep=32)
+    assert committed >= 4 * 12 * 10        # load flowed throughout
+    for g in range(4):
+        floor = node.plogs[0].start(g)
+        span = node.plogs[0].length(g) - floor
+        assert floor > 0, f"g{g} floor never advanced"
+        # keep(=W) + in-flight slack bounds the retained span.
+        assert span <= 32 + 4 * 8 + 16, (g, span)
+    # Restart: replay from the compacted WAL (floors + suffix) works.
+    # Read the cursor AFTER stop(): it flushes the deferred publish of
+    # the final tick, advancing applied one last time.
+    node.stop()
+    applied_before = int(node._applied[0][0])
+    node2 = FusedClusterNode(cfg, str(tmp_path))
+    rep, _ = drain(node2, 0)
+    assert int(node2._applied[0][0]) == applied_before
+    assert rep, "nothing replayed above the compaction floor"
+    elect(node2)
+    node2.propose_many(0, [b"SET post compaction"])
+    for _ in range(25):
+        node2.tick()
+    post, _ = drain(node2, 0)
+    assert any(q == "SET post compaction" for (_, _, q) in post)
+    node2.stop()
