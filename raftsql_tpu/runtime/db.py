@@ -37,7 +37,7 @@ from typing import Callable, Dict, Optional, Tuple
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
 from raftsql_tpu.runtime.envelope import unwrap
-from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH
+from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH, RAW_PLAIN
 from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
@@ -45,7 +45,7 @@ from raftsql_tpu.utils.metrics import LatencyTimer
 def _expand_commit_item(item, node=None):
     """Normalize a commit_q item to per-entry (group, index, sql) tuples.
 
-    Three forms, discriminated explicitly:
+    Four forms, discriminated explicitly:
       - (RAW_BATCH, group, base_idx, [raw_bytes, ...]) — the live
         publish phase's tagged batch (entries at base_idx+1..): one
         queue put per group per tick, with the per-entry envelope
@@ -53,6 +53,12 @@ def _expand_commit_item(item, node=None):
         thread, off the tick's critical path (`node.dedup_for(g)`
         supplies the per-group DedupWindow — forward-retried
         duplicates apply exactly once);
+      - (RAW_PLAIN, group, base_idx, [raw_bytes, ...]) — same shape,
+        but payloads are PLAIN (never enveloped): only producers whose
+        proposals bypass the wrap/forward path may emit it (the
+        fused/mesh runtimes, which route proposals on the host).
+        Tagging wrapped payloads RAW_PLAIN would apply entries with
+        envelope header bytes prepended;
       - (group, index, sql_str) — WAL replay per-entry items (the
         nil-sentinel counting protocol must stay item-accurate there);
       - (group, [(index, sql), ...]) — decoded per-group batches (older
@@ -71,6 +77,10 @@ def _expand_commit_item(item, node=None):
                 continue                    # forward-retry duplicate
             out.append((g, base + 1 + off, payload.decode("utf-8")))
         return out
+    if item[0] is RAW_PLAIN:
+        _, g, base, datas = item
+        return [(g, base + 1 + off, data.decode("utf-8"))
+                for off, data in enumerate(datas) if data]
     if len(item) == 2:
         g = item[0]
         return [(g, i, s) for (i, s) in item[1]]

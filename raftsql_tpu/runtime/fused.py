@@ -56,7 +56,7 @@ from raftsql_tpu.core.cluster import (cluster_step_host,
                                       init_cluster_state)
 from raftsql_tpu.core.state import restore_peer_state
 from raftsql_tpu.core.step import INFO_FIELDS
-from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH
+from raftsql_tpu.runtime.node import CLOSED, RAW_PLAIN
 from raftsql_tpu.storage.log import PayloadLog
 from raftsql_tpu.storage.wal import WAL, wal_exists
 from raftsql_tpu.utils.metrics import NodeMetrics
@@ -71,7 +71,7 @@ class FusedClusterNode:
     `propose_many(group, payloads)` routes to the current leader peer,
     `tick()` advances the whole cluster one step, `commit_q(peer)` is
     that peer's totally-ordered commit stream (same item protocol as
-    RaftNode: any replayed (RAW_BATCH, g, base, [bytes...]) batches
+    RaftNode: any replayed (RAW_PLAIN, g, base, [bytes...]) batches
     first, then the None replay-complete sentinel, then live batches;
     CLOSED ends the stream), `leader_of(group)` reports the last hint.
     """
@@ -154,7 +154,7 @@ class FusedClusterNode:
             datas = plog.try_slice(g, gl.start + 1,
                                    max(commit - gl.start, 0))
             if datas:
-                self._commit_qs[p].put((RAW_BATCH, g, gl.start, datas))
+                self._commit_qs[p].put((RAW_PLAIN, g, gl.start, datas))
         return restore_peer_state(self.cfg, p, log_terms, hard, seed,
                                   starts=starts or None)
 
@@ -241,18 +241,22 @@ class FusedClusterNode:
 
         # Phase 1: mirror READS for every follower-accepted append, all
         # peers, before any payload-log write of this tick.
-        mirrors: List[Tuple[int, int, int, int, list]] = []
+        mirrors: List[Tuple[int, int, int, int, list, list]] = []
         for p in range(P):
             col = pinfo[p]
             accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
-            for g in accepted.tolist():
-                src = int(col[g, _C["app_from"]])
-                start = int(col[g, _C["app_start"]])
-                n = int(col[g, _C["app_n"]])
-                new_len = int(col[g, _C["new_log_len"]])
-                ents = self.plogs[src].slice_with_terms(g, start, n) \
-                    if n else []
-                mirrors.append((p, g, start, new_len, ents))
+            if not accepted.size:
+                continue
+            sub = col[accepted]
+            for g, src, start, n, new_len in zip(
+                    accepted.tolist(),
+                    sub[:, _C["app_from"]].tolist(),
+                    sub[:, _C["app_start"]].tolist(),
+                    sub[:, _C["app_n"]].tolist(),
+                    sub[:, _C["new_log_len"]].tolist()):
+                terms, datas = self.plogs[src].slice_columns(
+                    g, start, n) if n else ([], [])
+                mirrors.append((p, g, start, new_len, terms, datas))
 
         # Phase 2: WAL + payload-log writes, then one fsync per peer.
         # Record building is vectorized: per-entry group/index/term
@@ -293,14 +297,18 @@ class FusedClusterNode:
                                - np.repeat(offs, counts)
                                + np.repeat(starts, counts))
                 parts_t.append(np.repeat(term[ags], counts))
-                for g in ags.tolist():
-                    n = int(acc[g])
-                    q = self._props[p][g]
+                # One bulk tolist per column: python-int indexing in the
+                # loop beats a numpy scalar read + int() per field.
+                props_p = self._props[p]
+                for g, n, b0, tm in zip(ags.tolist(),
+                                        counts.tolist(),
+                                        starts.tolist(),
+                                        term[ags].tolist()):
+                    q = props_p[g]
                     batch = q[:n]
                     del q[:n]
                     w_d.extend(batch)
-                    puts.append((g, int(base[g]) + 1, batch,
-                                 [int(term[g])] * n, None))
+                    puts.append((g, b0, batch, [tm] * n, None))
                 self.metrics.proposals += tot
             # Mirrors last: their content was read in phase 1, so order
             # only decides which write wins a conflicting suffix — the
@@ -313,15 +321,13 @@ class FusedClusterNode:
             m_start: List[int] = []
             m_count: List[int] = []
             m_terms: List[int] = []
-            for (mp, g, start, new_len, ents) in mirrors:
+            for (mp, g, start, new_len, terms, datas) in mirrors:
                 if mp != p:
                     continue
-                terms = [t for (t, _) in ents]
-                datas = [d for (_, d) in ents]
-                if ents:
+                if datas:
                     m_g.append(g)
                     m_start.append(start)
-                    m_count.append(len(ents))
+                    m_count.append(len(datas))
                     m_terms.extend(terms)
                     w_d.extend(datas)
                 puts.append((g, start, datas, terms, new_len))
@@ -375,7 +381,7 @@ class FusedClusterNode:
                         f"peer {p} g{g}: payload log shorter than "
                         f"commit ({a}+{len(datas)} < {c})")
                 if any(datas):
-                    self._commit_qs[p].put((RAW_BATCH, g, a, datas))
+                    self._commit_qs[p].put((RAW_PLAIN, g, a, datas))
                 self._applied[p][g] = c
                 if p == 0:
                     self.metrics.commits += c - a

@@ -86,10 +86,15 @@ def _view_info(ginfo: np.ndarray, next_idx: np.ndarray) -> _PackedView:
 
 # Discriminator heading a live publish-phase commit item:
 # (RAW_BATCH, group, base_idx, [raw_bytes, ...]).  The queue carries
-# three item shapes (see runtime/db.py _expand_commit_item); the raw
+# several item shapes (see runtime/db.py _expand_commit_item); the raw
 # form is the only one whose payloads still need envelope unwrap/dedup,
 # so it is tagged explicitly rather than sniffed by payload type.
 RAW_BATCH = object()
+# Same shape, but payloads are PLAIN bytes — no dedup envelopes (the
+# fused/mesh runtimes route proposals on the host and never wrap).
+# Expansion skips the per-entry unwrap probe, which is a measurable
+# share of the consumer at durable-bench saturation.
+RAW_PLAIN = object()
 
 
 class RaftNode:

@@ -155,13 +155,17 @@ class PayloadLog:
                 return None
             return self._datas[group][s: s + n]
 
-    def slice_with_terms(self, group: int, start: int, n: int
-                         ) -> List[Tuple[int, bytes]]:
+    def slice_columns(self, group: int, start: int, n: int
+                      ) -> Tuple[List[int], List[bytes]]:
+        """(terms, payloads) for [start, start+n) as two C-level list
+        slices — the mirror hot path (runtime/fused.py); a tuple-zipping
+        variant of this accessor was the second-largest per-entry cost
+        of the durable tick."""
         with self._mu:
             s = start - 1 - self._start[group]
             assert s >= 0, "slice below compaction floor"
-            return list(zip(self._terms[group][s: s + n],
-                            self._datas[group][s: s + n]))
+            return (self._terms[group][s: s + n],
+                    self._datas[group][s: s + n])
 
     def put(self, group: int, start: int, payloads: Sequence[bytes],
             terms: Sequence[int], new_len: Optional[int] = None) -> None:

@@ -255,23 +255,22 @@ class WAL:
         n = len(groups)
         if n == 0:
             return
-        # One dict op per record; max-per-group without trusting callers
-        # to keep the documented ascending order (the per-record bump()
-        # get+compare was ~10% of the saturated WAL phase).
-        last: Dict[int, int] = {}
-        get = last.get
-        for g, i in zip(groups, indexes):
-            if i > get(g, -1):
-                last[g] = i
-        bump = self._active_stats.bump
-        for g, i in last.items():
-            bump(g, i)
         blob = b"".join(datas)
         # numpy list→array conversion marshals the parallel arrays ~5x
         # faster than ctypes (c_uint32 * n)(*list) star-unpacking.
         ga = np.asarray(groups, np.uint32)
         ia = np.asarray(indexes, np.uint64)
         ta = np.asarray(terms, np.uint64)
+        # Segment stats (per-group max index) per contiguous RUN, not per
+        # record: within a run indexes ascend (the documented batch
+        # contract), so the run's last element is its max; bump()'s
+        # compare arbitrates across runs of the same group.  The
+        # per-record dict pass this replaces was ~8% of the WAL phase.
+        ends = np.nonzero(np.diff(ga))[0]
+        bump = self._active_stats.bump
+        for e in ends.tolist():
+            bump(int(ga[e]), int(ia[e]))
+        bump(int(ga[-1]), int(ia[-1]))
         la = np.fromiter(map(len, datas), np.uint32, n)
         self._lib.wal_append_entries(
             self._h, n,
