@@ -880,6 +880,7 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
 
     from raftsql_tpu.config import RaftConfig
     from raftsql_tpu.models.kv_sm import KVStateMachine
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
     from raftsql_tpu.runtime.db import _expand_commit_item
     from raftsql_tpu.runtime.fused import FusedClusterNode
 
@@ -888,7 +889,21 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                      log_window=max(64, 4 * E),
                      max_entries_per_msg=E, tick_interval_s=0.0)
     tmp = tempfile.mkdtemp(prefix="bench-fused-")
-    sms = [KVStateMachine() for _ in range(groups)]
+    # BENCH_SM=sqlite: the reference-parity apply engine (one SQLite
+    # database per group, group-committed transactions) — the FULL
+    # product stack on the fused runtime.  Default: in-memory KV.
+    sm_kind = ("sqlite" if os.environ.get("BENCH_SM") == "sqlite"
+               else "kv")     # the branch actually taken gets recorded
+    if sm_kind == "sqlite":
+        sms = [SQLiteStateMachine(os.path.join(tmp, f"sm-{g}.db"))
+               for g in range(groups)]
+        for g, sm in enumerate(sms):
+            err = sm.apply("CREATE TABLE t (v text)", 0)
+            assert err is None, err
+        mk_cmd = b"INSERT INTO t (v) VALUES ('x')"
+    else:
+        sms = [KVStateMachine() for _ in range(groups)]
+        mk_cmd = None                      # kv: unique keys per batch
 
     def drain(node, apply: bool, t0q=None, lats=None) -> int:
         cnt = 0
@@ -945,7 +960,8 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             m = node.metrics
             m.ticks = 0
             m.t_device_ms = m.t_wal_ms = m.t_publish_ms = 0.0
-            cmds = [f"SET k{i} v".encode() for i in range(ticks * E)]
+            cmds = ([mk_cmd] * (ticks * E) if mk_cmd is not None else
+                    [f"SET k{i} v".encode() for i in range(ticks * E)])
             for g in range(active):
                 node.propose_many(g, cmds)
             drain(node, apply=False)
@@ -975,7 +991,8 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                 break
         for t in range(lat_ticks):
             now = time.perf_counter()
-            cmds = [f"SET lat{t}_{i} v".encode() for i in range(E)]
+            cmds = ([mk_cmd] * E if mk_cmd is not None else
+                    [f"SET lat{t}_{i} v".encode() for i in range(E)])
             for g in range(lat_active):
                 node.propose_many(g, cmds)
                 t0q[g].extend([now] * E)
@@ -996,7 +1013,7 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             _log(f"  fused durable latency: p50={lat_stats['p50_ms']} ms "
                  f"p99={lat_stats['p99_ms']} ms over {len(lats)} acks, "
                  f"{censored} censored")
-        return best, {"durable_mode": "fused",
+        return best, {"durable_mode": "fused", "durable_sm": sm_kind,
                       "durable_phase_ms": phase,
                       "durable_tick_ms": round(sum(phase.values()), 3),
                       "durable_lat": lat_stats,
@@ -1193,7 +1210,8 @@ def child_main() -> None:
         # and nothing noticed.
         shape = {"config": config,
                  "groups": os.environ.get("BENCH_GROUPS", ""),
-                 "e": os.environ.get("BENCH_E", "")}
+                 "e": os.environ.get("BENCH_E", ""),
+                 "sm": os.environ.get("BENCH_SM", "")}
         prev = _ledger_last_matching(shape)
         # Direction-aware: latency's value is p50 ms (lower = better);
         # everything else is commits/s (higher = better).
@@ -1222,6 +1240,7 @@ def child_main() -> None:
             "config": config,
             "groups": os.environ.get("BENCH_GROUPS", ""),
             "e": os.environ.get("BENCH_E", ""),
+            "sm": os.environ.get("BENCH_SM", ""),
         })
         _ledger_append(rec)
     print(json.dumps(out))
@@ -1528,15 +1547,19 @@ def main() -> None:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
+            parsed["durable_sm"] = durable.get("durable_sm")
         if durable_tpu:
             parsed["durable_tpu_commits_per_s"] = durable_tpu.get("value")
             parsed["durable_tpu_tick_ms"] = \
                 durable_tpu.get("durable_tick_ms")
             parsed["durable_tpu_lat"] = durable_tpu.get("durable_lat")
             parsed["durable_tpu_platform"] = durable_tpu.get("platform")
+            parsed["durable_tpu_sm"] = durable_tpu.get("durable_sm")
         if httpc:
             parsed["http_req_per_s"] = httpc.get("value")
             parsed["http_lat"] = httpc.get("http_lat")
+            parsed["http_lat_hi"] = httpc.get("http_lat_hi")
+            parsed["http_cpu_count"] = httpc.get("cpu_count")
         _emit(parsed)
         return
 
@@ -1555,9 +1578,12 @@ def main() -> None:
             parsed["durable_commits_per_s"] = durable.get("value")
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
+            parsed["durable_sm"] = durable.get("durable_sm")
         if httpc:
             parsed["http_req_per_s"] = httpc.get("value")
             parsed["http_lat"] = httpc.get("http_lat")
+            parsed["http_lat_hi"] = httpc.get("http_lat_hi")
+            parsed["http_cpu_count"] = httpc.get("cpu_count")
         # Clearly-labeled history, not a headline: the newest committed
         # TPU_RUNS.jsonl entry, so a wedged tunnel leaves a citable
         # last-known-good TPU result in the official record.
