@@ -965,11 +965,22 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             for g in range(active):
                 node.propose_many(g, cmds)
             drain(node, apply=False)
+            # Drain+apply rides the runtime's overlap hook: through a
+            # remote-device tunnel the dispatch+compute window is idle
+            # host time, so the apply plane runs there for free (on a
+            # local backend it's equivalent to draining after tick()).
+            applied = 0
+
+            def hook():
+                nonlocal applied
+                applied += drain(node, apply=True)
+
+            node.overlap_hook = hook
             t0 = time.perf_counter()
-            committed = 0
             for _ in range(ticks):
                 node.tick()
-                committed += drain(node, apply=True)
+            node.overlap_hook = None
+            committed = applied + drain(node, apply=True)
             dt = time.perf_counter() - t0
             rate = committed / dt
             _log(f"  {committed} fused durable commits in {dt:.3f}s -> "
