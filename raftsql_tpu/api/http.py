@@ -133,14 +133,20 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    # The stdlib default listen backlog of 5 resets connections when a
+    # burst of concurrent keep-alive clients arrives; scoped here so no
+    # other ThreadingHTTPServer in the process is affected.
+    request_queue_size = 256
+
+
 class SQLServer:
     """Stoppable HTTP server (the reference's stoppable listener pattern,
     listener.go:25-59, applied to the client API)."""
 
     def __init__(self, port: int, rdb: RaftDB, host: str = "",
                  timeout_s: float = 30.0):
-        self.httpd = ThreadingHTTPServer((host, port),
-                                         _make_handler(rdb, timeout_s))
+        self.httpd = _Server((host, port), _make_handler(rdb, timeout_s))
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

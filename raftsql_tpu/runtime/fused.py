@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import os
 import queue
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -91,10 +92,14 @@ class FusedClusterNode:
         self._hard[:, :, 1] = -1
         # Per-(peer, group) proposal queues as plain lists: the tick
         # pops a whole batch with one C-level slice + del, vs a Python
-        # popleft per entry on a deque.
+        # popleft per entry on a deque.  _prop_lock covers _props and
+        # _queued: under the threaded --fused deployment (start()),
+        # HTTP client threads propose concurrently with the tick
+        # thread's routing and batch pops.
         self._props: List[List[list]] = [
             [[] for _ in range(G)] for _ in range(P)]
         self._queued: set = set()            # (peer, group) with backlog
+        self._prop_lock = threading.Lock()
         self._hints = np.full(G, -1, np.int64)
         self._tick_no = 0
         # Last tick's packed info, published at the START of the next
@@ -108,6 +113,10 @@ class FusedClusterNode:
         # must only consume the commit queues (anything else races the
         # tick).
         self.overlap_hook = None
+        self.error: Optional[Exception] = None
+        self._work_evt = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
 
         states = []
         for p in range(P):
@@ -181,8 +190,50 @@ class FusedClusterNode:
         p = int(self._hints[group])
         if p < 0:
             p = 0
-        self._props[p][group].extend(payloads)
-        self._queued.add((p, group))
+        with self._prop_lock:
+            self._props[p][group].extend(payloads)
+            self._queued.add((p, group))
+        self._work_evt.set()
+
+    # -- threaded serving (the --fused single-process deployment) -------
+
+    def start(self, interval_s: float = 0.002) -> None:
+        """Run the tick loop on a background thread: wake immediately on
+        proposals, tick at `interval_s` otherwise.  Variable tick rate
+        cannot distort raft timing here — ALL peers advance in the same
+        fused step, so their relative timers never skew and elections
+        fire only when a group actually lacks a leader."""
+        def _run():
+            while not self._stop_evt.is_set():
+                self._work_evt.clear()
+                try:
+                    self.tick()
+                except Exception as e:   # pragma: no cover - defensive
+                    self.error = e
+                    for q in self._commit_qs:
+                        q.put(CLOSED)
+                    return
+                self._work_evt.wait(interval_s)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="fused-cluster")
+        self._thread.start()
+
+    # -- linearizable reads (single-controller cluster) -----------------
+
+    def read_index(self, group: int):
+        """ReadIndex for the co-located cluster: every peer of the
+        group lives in THIS process, so no other process can hold a
+        newer leadership — the leader's current commit index IS the
+        linearization point, no quorum round needed.  Returns () while
+        the group has no leader yet (caller polls)."""
+        p = int(self._hints[group])
+        if p < 0:
+            return ()
+        return int(self._hard[p, group, 2]), 0
+
+    def read_ready(self, group: int, reg_tick: int) -> bool:
+        return True
 
     # -- the tick -------------------------------------------------------
 
@@ -190,22 +241,23 @@ class FusedClusterNode:
         P, G = self.cfg.num_peers, self.cfg.num_groups
         prop_n = np.zeros((P, G), np.int32)
         dead = []
-        for (p, g) in list(self._queued):     # snapshot: re-routes mutate
-            q = self._props[p][g]
-            if not q:
-                dead.append((p, g))
-                continue
-            h = int(self._hints[g])
-            if 0 <= h != p:
-                # Re-route a backlog stranded at a deposed/wrong peer.
-                self._props[h][g].extend(q)
-                q.clear()
-                self._queued.add((h, g))
-                dead.append((p, g))
-                continue
-            prop_n[p, g] = min(len(q), self._E)
-        for k in dead:
-            self._queued.discard(k)
+        with self._prop_lock:
+            for (p, g) in list(self._queued):  # snapshot: re-routes mutate
+                q = self._props[p][g]
+                if not q:
+                    dead.append((p, g))
+                    continue
+                h = int(self._hints[g])
+                if 0 <= h != p:
+                    # Re-route a backlog stranded at a deposed/wrong peer.
+                    self._props[h][g].extend(q)
+                    q.clear()
+                    self._queued.add((h, g))
+                    dead.append((p, g))
+                    continue
+                prop_n[p, g] = min(len(q), self._E)
+            for k in dead:
+                self._queued.discard(k)
         return prop_n
 
     def _device_step(self, prop_n: np.ndarray):
@@ -314,15 +366,16 @@ class FusedClusterNode:
                 # One bulk tolist per column: python-int indexing in the
                 # loop beats a numpy scalar read + int() per field.
                 props_p = self._props[p]
-                for g, n, b0, tm in zip(ags.tolist(),
-                                        counts.tolist(),
-                                        starts.tolist(),
-                                        term[ags].tolist()):
-                    q = props_p[g]
-                    batch = q[:n]
-                    del q[:n]
-                    w_d.extend(batch)
-                    puts.append((g, b0, batch, [tm] * n, None))
+                with self._prop_lock:   # pops race client-thread extends
+                    for g, n, b0, tm in zip(ags.tolist(),
+                                            counts.tolist(),
+                                            starts.tolist(),
+                                            term[ags].tolist()):
+                        q = props_p[g]
+                        batch = q[:n]
+                        del q[:n]
+                        w_d.extend(batch)
+                        puts.append((g, b0, batch, [tm] * n, None))
                 self.metrics.proposals += tot
             # Mirrors last: their content was read in phase 1, so order
             # only decides which write wins a conflicting suffix — the
@@ -402,7 +455,8 @@ class FusedClusterNode:
 
     # -- log compaction (SURVEY §5.4) -----------------------------------
 
-    def compact(self, keep: int = 1024) -> bool:
+    def compact(self, applied: Optional[Dict[int, int]] = None,
+                keep: int = 1024) -> bool:
         """Advance every peer's compaction floor to (applied - keep):
         payload-log prefixes drop, COMPACT markers land in the WALs, and
         fully-superseded closed segments unlink (storage/wal.py compact)
@@ -411,8 +465,12 @@ class FusedClusterNode:
 
         `keep` is clamped to >= log_window so every index the device
         ring can still reference stays servable (mirror reads and
-        in-window resends).  The applied cursor gates the floor: only
+        in-window resends).  The publish cursor gates the floor: only
         entries already delivered to the apply plane are dropped.
+        `applied` optionally tightens it further to the state machines'
+        DURABLY applied indexes — the calling convention RaftDB's
+        snapshot-driven compaction uses (runtime/db.py _maybe_compact),
+        so the --fused --resume --compact-every deployment works.
         """
         keep = max(keep, self.cfg.log_window)
         G = self.cfg.num_groups
@@ -423,6 +481,8 @@ class FusedClusterNode:
             changed = False
             for g in range(G):
                 floor = int(self._applied[p][g]) - keep
+                if applied is not None:
+                    floor = min(floor, applied.get(g, 0) - keep)
                 if floor > plog.start(g):
                     plog.compact(g, floor, plog.term_of(g, floor))
                     changed = True
@@ -440,6 +500,11 @@ class FusedClusterNode:
     # -- teardown -------------------------------------------------------
 
     def stop(self) -> None:
+        if self._thread is not None:
+            self._stop_evt.set()
+            self._work_evt.set()
+            self._thread.join(timeout=10)
+            self._thread = None
         if self._pending_pinfo is not None:
             self._publish(self._pending_pinfo)    # already durable
             self._pending_pinfo = None
@@ -453,6 +518,31 @@ class FusedClusterNode:
     def roles(self) -> np.ndarray:
         """[P, G] role matrix from the live device state."""
         return np.asarray(self.states.role)
+
+
+class FusedPipe:
+    """The propose/commit/error facade (reference raftpipe.go:3-17) over
+    a FusedClusterNode, so the whole SQL stack above consensus —
+    RaftDB ack routing, HTTP API, CLI — serves from the co-located
+    runtime unchanged.  Peer 0's commit stream is the apply plane: one
+    process IS the cluster, so one local replica applies (the other
+    peers' durability lives in their WALs; a restart replays any of
+    them)."""
+
+    def __init__(self, node: FusedClusterNode):
+        self.node = node
+        self.commit_q = node.commit_q(0)
+
+    def propose(self, group: int, payload: bytes) -> None:
+        self.node.propose_many(group, [payload])
+
+    @property
+    def error(self) -> Optional[Exception]:
+        return self.node.error
+
+    def close(self) -> Optional[Exception]:
+        self.node.stop()
+        return self.node.error
 
 
 class MeshClusterNode(FusedClusterNode):

@@ -87,6 +87,37 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
                   compact_every=compact_every, compact_keep=compact_keep)
 
 
+def build_fused_node(groups: int = 1, peers: int = 3,
+                     tick: float = 0.002,
+                     data_prefix: str = "raftsql",
+                     resume: bool = False,
+                     compact_every: int = 0, compact_keep: int = 1024,
+                     wal_segment_bytes: int = 4 << 20) -> RaftDB:
+    """The --fused single-process deployment: all P peers of every
+    group co-located in THIS process, consensus advanced by ONE fused
+    device program per tick (runtime/fused.py), per-peer WALs on disk,
+    SQLite applied from peer 0's commit stream.  The TPU-native answer
+    to the reference's 3-process Procfile cluster: same durability
+    (fsync-per-peer between dispatches = save-before-send), no
+    cross-process hops on the propose→commit path."""
+    from raftsql_tpu.runtime.fused import FusedClusterNode, FusedPipe
+
+    cfg = RaftConfig(num_groups=groups, num_peers=peers,
+                     tick_interval_s=tick,
+                     wal_segment_bytes=wal_segment_bytes)
+    node = FusedClusterNode(cfg, f"{data_prefix}-fused")
+    node.start(interval_s=max(tick, 0.0005))
+    pipe = FusedPipe(node)
+
+    def sm_factory(g: int) -> SQLiteStateMachine:
+        path = (f"{data_prefix}-fused.db" if g == 0
+                else f"{data_prefix}-fused-g{g}.db")
+        return SQLiteStateMachine(path, resume=resume)
+
+    return RaftDB(sm_factory, pipe, num_groups=groups, resume=resume,
+                  compact_every=compact_every, compact_keep=compact_keep)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="TPU-native replicated SQL")
     ap.add_argument("--cluster", default="http://127.0.0.1:9021",
@@ -111,6 +142,12 @@ def main(argv=None) -> None:
     ap.add_argument("--wal-segment-bytes", type=int, default=4 << 20,
                     help="rotate WAL segments at this size; compaction "
                          "unlinks whole covered segments")
+    ap.add_argument("--fused", action="store_true",
+                    help="single-process cluster: all --peers raft "
+                         "peers co-located on one device, one fused "
+                         "step per tick (no --cluster/--id needed)")
+    ap.add_argument("--peers", type=int, default=3,
+                    help="with --fused: peers per group")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -130,11 +167,18 @@ def main(argv=None) -> None:
     # (runtime/node.py _run; SURVEY.md §5.1 — host-side profiling of
     # the serving process, the complement of the JAX profiler's device
     # traces in bench.py).
-    rdb = build_node(args.cluster, args.id, groups=args.groups,
-                     tick=args.tick, resume=args.resume,
-                     compact_every=args.compact_every,
-                     compact_keep=args.compact_keep,
-                     wal_segment_bytes=args.wal_segment_bytes)
+    if args.fused:
+        rdb = build_fused_node(groups=args.groups, peers=args.peers,
+                               tick=args.tick, resume=args.resume,
+                               compact_every=args.compact_every,
+                               compact_keep=args.compact_keep,
+                               wal_segment_bytes=args.wal_segment_bytes)
+    else:
+        rdb = build_node(args.cluster, args.id, groups=args.groups,
+                         tick=args.tick, resume=args.resume,
+                         compact_every=args.compact_every,
+                         compact_keep=args.compact_keep,
+                         wal_segment_bytes=args.wal_segment_bytes)
     serve_http_sql_api(args.port, rdb)
 
 

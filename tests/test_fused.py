@@ -195,3 +195,28 @@ def test_fused_compaction_bounds_log_under_load(tmp_path):
     post, _ = drain(node2, 0)
     assert any(q == "SET post compaction" for (_, _, q) in post)
     node2.stop()
+
+
+def test_fused_pipe_raftdb_sql_stack(tmp_path, monkeypatch):
+    """The --fused deployment's stack: FusedClusterNode -> FusedPipe ->
+    RaftDB(SQLite) serves writes with blocking acks, local reads, and
+    linearizable reads, in one process (server/main.py build_fused_node
+    wiring, driven in-process here)."""
+    monkeypatch.chdir(tmp_path)
+    from raftsql_tpu.server.main import build_fused_node
+
+    rdb = build_fused_node(groups=2, peers=3, tick=0.002)
+    try:
+        assert rdb.propose("CREATE TABLE t (v text)", 0).wait(30) is None
+        assert rdb.propose("INSERT INTO t (v) VALUES ('x')",
+                           0).wait(30) is None
+        # Group isolation: group 1 has its own database.
+        err = rdb.propose("INSERT INTO t (v) VALUES ('y')", 1).wait(30)
+        assert err is not None          # no such table in group 1
+        assert rdb.query("SELECT v FROM t", 0) == "|x|\n"
+        # Linearizable read: single-controller cluster, leader commit
+        # is the linearization point (runtime/fused.py read_index).
+        assert rdb.query("SELECT count(*) FROM t", 0,
+                         linear=True, timeout=30) == "|1|\n"
+    finally:
+        rdb.close()
