@@ -83,9 +83,22 @@ def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
     messages stay on device (the delivered inboxes are returned as
     opaque carry), and the host-facing StepInfo crosses as ONE packed
     [P, G, INFO_NCOLS] array (core/step.py pack_info) — the host pays a
-    single transfer per tick however many peers and groups advance."""
+    single transfer per tick however many peers and groups advance.
+
+    The extra scalar `busy` reports device-only protocol work the
+    packed info cannot show — vote traffic, entry-carrying appends, and
+    REJECTED append responses (a post-restart log-reconciliation walk
+    is nothing but probe/reject rounds with zero host-visible effect).
+    The runtime's idle-parking loop must keep full pace while it is
+    set; steady-state heartbeats (empty REQ, successful RESP) do not
+    count, so a settled cluster still parks."""
+    from raftsql_tpu.config import MSG_REQ, MSG_RESP
+
     st, ib, infos = cluster_step(cfg, states, inboxes, prop_n)
-    return st, ib, jax.vmap(pack_info)(infos)
+    busy = (jnp.any(ib.v_type != 0)
+            | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
+            | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))
+    return st, ib, jax.vmap(pack_info)(infos), busy
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))

@@ -117,6 +117,7 @@ class FusedClusterNode:
         self._work_evt = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._tick_active = True
 
         states = []
         for p in range(P):
@@ -198,11 +199,15 @@ class FusedClusterNode:
     # -- threaded serving (the --fused single-process deployment) -------
 
     def start(self, interval_s: float = 0.002) -> None:
-        """Run the tick loop on a background thread: wake immediately on
-        proposals, tick at `interval_s` otherwise.  Variable tick rate
-        cannot distort raft timing here — ALL peers advance in the same
-        fused step, so their relative timers never skew and elections
-        fire only when a group actually lacks a leader."""
+        """Run the tick loop on a background thread: wake immediately
+        on proposals; tick at `interval_s` while consensus is active;
+        PARK at a 0.5 s safety heartbeat once the cluster is quiet
+        (nothing queued, committed-but-unpublished, leaderless, written
+        this tick, or busy on-device — see cluster_step_host's busy
+        bit).  Pausing a quiet cluster is safe precisely because it is
+        single-controller: ALL peers pause together, so no peer can
+        observe missed heartbeats, no timer skews, and elections fire
+        only when a group actually lacks a leader."""
         def _run():
             while not self._stop_evt.is_set():
                 self._work_evt.clear()
@@ -213,7 +218,13 @@ class FusedClusterNode:
                     for q in self._commit_qs:
                         q.put(CLOSED)
                     return
-                self._work_evt.wait(interval_s)
+                # Idle parking: a QUIET single-controller cluster can
+                # pause consensus outright — every peer pauses with it,
+                # so no election can fire spuriously and nothing is
+                # missed; the next proposal (work event) resumes it.
+                # The 0.5 s cap is a safety heartbeat.
+                self._work_evt.wait(
+                    interval_s if self._tick_active else 0.5)
 
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name="fused-cluster")
@@ -261,12 +272,13 @@ class FusedClusterNode:
         return prop_n
 
     def _device_step(self, prop_n: np.ndarray):
-        """Dispatch one cluster step; returns the packed-info device
-        array.  MeshClusterNode overrides this with the shard_map'd
-        step — the durable host plane below is identical either way."""
-        self.states, self.inboxes, pinfo_dev = cluster_step_host(
+        """Dispatch one cluster step; returns (packed-info device array,
+        device busy bit or None).  MeshClusterNode overrides this with
+        the shard_map'd step — the durable host plane below is identical
+        either way."""
+        self.states, self.inboxes, pinfo_dev, busy = cluster_step_host(
             self.cfg, self.states, self.inboxes, jnp.asarray(prop_n))
-        return pinfo_dev
+        return pinfo_dev, busy
 
     def tick(self) -> None:
         """One fused step + the durable host phase.
@@ -285,7 +297,7 @@ class FusedClusterNode:
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n()
-        pinfo_dev = self._device_step(prop_n)
+        pinfo_dev, busy_dev = self._device_step(prop_n)
         t1 = _t.monotonic()
         # Overlap: tick t-1's commits are durable (fsynced last tick);
         # deliver them to the apply plane while the device computes.
@@ -301,6 +313,7 @@ class FusedClusterNode:
         else:
             t2b = t2
         pinfo = np.asarray(jax.device_get(pinfo_dev))     # [P, G, NCOLS]
+        dev_busy = bool(busy_dev) if busy_dev is not None else True
         t3 = _t.monotonic()
 
         self._hints = pinfo[0, :, _C["leader_hint"]]
@@ -325,6 +338,7 @@ class FusedClusterNode:
                 mirrors.append((p, g, start, new_len, terms, datas))
 
         # Phase 2: WAL + payload-log writes, then one fsync per peer.
+        tick_active = bool(mirrors)
         # Record building is vectorized: per-entry group/index/term
         # columns come from numpy repeat/arange over the per-group
         # counts; Python touches each GROUP once, each entry's bytes
@@ -423,9 +437,20 @@ class FusedClusterNode:
                                             hs[changed, 1],
                                             hs[changed, 2])
                 self._hard[p][changed] = hs[changed]
+            if parts_g or changed.size:
+                tick_active = True
             self.wals[p].sync()          # the durable barrier, per peer
         t4 = _t.monotonic()
         self._pending_pinfo = pinfo
+        # Quiescence signal for the threaded loop: anything written,
+        # any commit not yet published, any group leaderless, or any
+        # proposal backlog means "keep ticking at full pace".
+        self._tick_active = (tick_active
+                             or dev_busy
+                             or bool((pinfo[:, :, _C["commit"]]
+                                      > self._applied).any())
+                             or bool((self._hints < 0).any())
+                             or bool(self._queued))
         self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
         self.metrics.t_publish_ms += (t2 - t1) * 1e3
         self.metrics.t_wal_ms += (t4 - t3) * 1e3
@@ -580,4 +605,4 @@ class MeshClusterNode(FusedClusterNode):
     def _device_step(self, prop_n: np.ndarray):
         self.states, self.inboxes, pinfo_dev = self._sharded_step(
             self.states, self.inboxes, jnp.asarray(prop_n))
-        return pinfo_dev
+        return pinfo_dev, None      # mesh runtime: manual ticking only
