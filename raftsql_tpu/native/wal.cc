@@ -227,3 +227,341 @@ int wal_close(void* h) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native payload log — the host byte store behind the device's term
+// metadata (the C++ counterpart of storage/log.py PayloadLog), plus the
+// combined walplog_* entry points the fused runtime's durable tick uses:
+// one ctypes call writes a whole tick's WAL records AND payload-log
+// ranges for a peer, and one call performs every follower mirror for the
+// whole cluster with the read-all-before-write-all ordering the
+// same-tick truncation hazard requires (runtime/fused.py module doc).
+
+namespace {
+
+struct PlogGroup {
+  std::vector<std::string> datas;
+  std::vector<uint64_t> terms;
+  uint64_t start = 0;
+  uint64_t start_term = 0;
+};
+
+struct Plog {
+  std::vector<PlogGroup> groups;
+  std::mutex mu;
+};
+
+// Write [start, start+n) into g (tail-extend fast path, in-place
+// overwrite otherwise); truncate to new_len if >= 0.  Returns -1 on a
+// gap (callers treat as fatal — indexes must be contiguous).
+int plog_put_locked(PlogGroup& pg, uint64_t start, uint32_t n,
+                    const uint64_t* terms, const uint8_t* blob,
+                    const uint32_t* lens, int64_t new_len) {
+  int64_t rel = int64_t(start) - 1 - int64_t(pg.start);
+  size_t off = 0;
+  if (rel == int64_t(pg.datas.size())) {
+    for (uint32_t i = 0; i < n; ++i) {
+      pg.datas.emplace_back(reinterpret_cast<const char*>(blob + off),
+                            lens[i]);
+      pg.terms.push_back(terms[i]);
+      off += lens[i];
+    }
+  } else {
+    for (uint32_t i = 0; i < n; ++i) {
+      int64_t pos = rel + int64_t(i);
+      if (pos < 0) { off += lens[i]; continue; }  // below floor
+      if (pos < int64_t(pg.datas.size())) {
+        pg.datas[size_t(pos)].assign(
+            reinterpret_cast<const char*>(blob + off), lens[i]);
+        pg.terms[size_t(pos)] = terms[i];
+      } else if (pos == int64_t(pg.datas.size())) {
+        pg.datas.emplace_back(reinterpret_cast<const char*>(blob + off),
+                              lens[i]);
+        pg.terms.push_back(terms[i]);
+      } else {
+        return -1;
+      }
+      off += lens[i];
+    }
+  }
+  if (new_len >= 0) {
+    int64_t keep = new_len - int64_t(pg.start);
+    if (keep < 0) keep = 0;
+    if (size_t(keep) < pg.datas.size()) {
+      pg.datas.resize(size_t(keep));
+      pg.terms.resize(size_t(keep));
+    }
+  }
+  return 0;
+}
+
+void wal_entry_locked(Wal* w, std::vector<uint8_t>& body, uint32_t g,
+                      uint64_t idx, uint64_t term, const uint8_t* data,
+                      uint32_t len) {
+  body.clear();
+  body.reserve(21 + len);
+  body.push_back(1);
+  put_u32(body, g);
+  put_u64(body, idx);
+  put_u64(body, term);
+  if (len) body.insert(body.end(), data, data + len);
+  frame(w, body);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* plog_new(uint32_t num_groups) {
+  Plog* p = new Plog();
+  p->groups.resize(num_groups);
+  return p;
+}
+
+void plog_free(void* h) { delete static_cast<Plog*>(h); }
+
+uint64_t plog_length(void* h, uint32_t g) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->groups[g].start + p->groups[g].datas.size();
+}
+
+uint64_t plog_start(void* h, uint32_t g) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->groups[g].start;
+}
+
+uint64_t plog_start_term(void* h, uint32_t g) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->groups[g].start_term;
+}
+
+int plog_set_start(void* h, uint32_t g, uint64_t start,
+                   uint64_t start_term) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  PlogGroup& pg = p->groups[g];
+  if (!pg.datas.empty()) return -1;
+  pg.start = start;
+  pg.start_term = start_term;
+  return 0;
+}
+
+// Term of entry idx; idx == 0 -> 0, idx == start -> boundary term,
+// below-floor/beyond-tail -> UINT64_MAX (caller decides retry/assert).
+uint64_t plog_term_of(void* h, uint32_t g, uint64_t idx) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  PlogGroup& pg = p->groups[g];
+  if (idx == 0) return 0;
+  if (idx == pg.start) return pg.start_term;
+  if (idx < pg.start || idx > pg.start + pg.terms.size())
+    return ~uint64_t(0);
+  return pg.terms[size_t(idx - 1 - pg.start)];
+}
+
+int plog_compact(void* h, uint32_t g, uint64_t upto,
+                 uint64_t boundary_term) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  PlogGroup& pg = p->groups[g];
+  if (upto <= pg.start) return 0;
+  size_t drop = size_t(upto - pg.start);
+  if (drop > pg.datas.size()) return -1;
+  pg.datas.erase(pg.datas.begin(), pg.datas.begin() + drop);
+  pg.terms.erase(pg.terms.begin(), pg.terms.begin() + drop);
+  pg.start = upto;
+  pg.start_term = boundary_term;
+  return 0;
+}
+
+int plog_put_range(void* h, uint32_t g, uint64_t start, uint32_t n,
+                   const uint64_t* terms, const uint8_t* blob,
+                   const uint32_t* lens, int64_t new_len) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return plog_put_locked(p->groups[g], start, n, terms, blob, lens,
+                         new_len);
+}
+
+// Two-phase read: total byte size of [start, start+n), then fill.
+// Returns UINT64_MAX if the range dips below the floor or past the tail.
+uint64_t plog_range_bytes(void* h, uint32_t g, uint64_t start, uint32_t n) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  PlogGroup& pg = p->groups[g];
+  int64_t rel = int64_t(start) - 1 - int64_t(pg.start);
+  if (rel < 0 || size_t(rel) + n > pg.datas.size()) return ~uint64_t(0);
+  uint64_t total = 0;
+  for (uint32_t i = 0; i < n; ++i) total += pg.datas[size_t(rel) + i].size();
+  return total;
+}
+
+int plog_read_range(void* h, uint32_t g, uint64_t start, uint32_t n,
+                    uint8_t* blob_out, uint32_t* lens_out,
+                    uint64_t* terms_out) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  PlogGroup& pg = p->groups[g];
+  int64_t rel = int64_t(start) - 1 - int64_t(pg.start);
+  if (rel < 0 || size_t(rel) + n > pg.datas.size()) return -1;
+  size_t off = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    const std::string& d = pg.datas[size_t(rel) + i];
+    if (blob_out) std::memcpy(blob_out + off, d.data(), d.size());
+    if (lens_out) lens_out[i] = uint32_t(d.size());
+    if (terms_out) terms_out[i] = pg.terms[size_t(rel) + i];
+    off += d.size();
+  }
+  return 0;
+}
+
+// Batched multi-group read (the publish hot path): total bytes of all
+// ranges, then one fill of concatenated payloads + per-entry lens in
+// range order.  Returns UINT64_MAX / -1 if any range is unavailable.
+uint64_t plog_ranges_bytes(void* h, uint32_t n_ranges,
+                           const uint32_t* groups, const uint64_t* starts,
+                           const uint32_t* counts) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  uint64_t total = 0;
+  for (uint32_t r = 0; r < n_ranges; ++r) {
+    PlogGroup& pg = p->groups[groups[r]];
+    int64_t rel = int64_t(starts[r]) - 1 - int64_t(pg.start);
+    if (rel < 0 || size_t(rel) + counts[r] > pg.datas.size())
+      return ~uint64_t(0);
+    for (uint32_t i = 0; i < counts[r]; ++i)
+      total += pg.datas[size_t(rel) + i].size();
+  }
+  return total;
+}
+
+int plog_read_groups(void* h, uint32_t n_ranges, const uint32_t* groups,
+                     const uint64_t* starts, const uint32_t* counts,
+                     uint8_t* blob_out, uint32_t* lens_out) {
+  Plog* p = static_cast<Plog*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  size_t off = 0, li = 0;
+  for (uint32_t r = 0; r < n_ranges; ++r) {
+    PlogGroup& pg = p->groups[groups[r]];
+    int64_t rel = int64_t(starts[r]) - 1 - int64_t(pg.start);
+    if (rel < 0 || size_t(rel) + counts[r] > pg.datas.size()) return -1;
+    for (uint32_t i = 0; i < counts[r]; ++i) {
+      const std::string& d = pg.datas[size_t(rel) + i];
+      std::memcpy(blob_out + off, d.data(), d.size());
+      lens_out[li++] = uint32_t(d.size());
+      off += d.size();
+    }
+  }
+  return 0;
+}
+
+// Combined leader-append path: for each range i, write WAL ENTRY records
+// AND the payload-log range, all entries of range i sharing terms[i].
+// Ranges are (group, start, count) with payload bytes concatenated in
+// `blob` / per-entry `lens` in range order.  One call per peer per tick.
+int walplog_put_uniform(void* wal_h, void* plog_h, uint32_t n_ranges,
+                        const uint32_t* groups, const uint64_t* starts,
+                        const uint32_t* counts, const uint64_t* terms,
+                        const uint8_t* blob, const uint32_t* lens) {
+  Wal* w = static_cast<Wal*>(wal_h);
+  Plog* p = static_cast<Plog*>(plog_h);
+  std::lock_guard<std::mutex> lw(w->mu);
+  std::lock_guard<std::mutex> lp(p->mu);
+  size_t off = 0, li = 0;
+  std::vector<uint64_t> tbuf;
+  std::vector<uint8_t> body;
+  for (uint32_t r = 0; r < n_ranges; ++r) {
+    uint32_t n = counts[r];
+    tbuf.assign(n, terms[r]);
+    size_t range_bytes = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+      wal_entry_locked(w, body, groups[r], starts[r] + i, terms[r],
+                       blob + off + range_bytes, lens[li + i]);
+      range_bytes += lens[li + i];
+    }
+    int rc = plog_put_locked(p->groups[groups[r]], starts[r], n,
+                             tbuf.data(), blob + off, lens + li, -1);
+    if (rc != 0) return rc;
+    off += range_bytes;
+    li += n;
+  }
+  return 0;
+}
+
+// Combined mirror path for the WHOLE cluster: phase A reads every
+// source range into scratch (so a same-tick truncation or overwrite on
+// any source cannot tear any mirror — the read-all-before-write-all
+// contract); phase B writes each destination's payload-log range +
+// truncation and its WAL ENTRY records.  `wals`/`plogs` are per-peer
+// handle arrays; `peer`/`src` index them.
+int walplog_mirror_all(void** wals, void** plogs, uint32_t n_mirrors,
+                       const uint32_t* peer, const uint32_t* src,
+                       const uint32_t* groups, const uint64_t* starts,
+                       const uint32_t* counts, const int64_t* new_lens,
+                       uint64_t* per_peer_bytes) {
+  struct Scratch {
+    std::vector<std::string> datas;
+    std::vector<uint64_t> terms;
+  };
+  std::vector<Scratch> scratch(n_mirrors);
+  for (uint32_t i = 0; i < n_mirrors; ++i) {
+    Plog* sp = static_cast<Plog*>(plogs[src[i]]);
+    std::lock_guard<std::mutex> lk(sp->mu);
+    PlogGroup& pg = sp->groups[groups[i]];
+    int64_t rel = int64_t(starts[i]) - 1 - int64_t(pg.start);
+    uint32_t n = counts[i];
+    if (n == 0) continue;
+    if (rel < 0 || size_t(rel) + n > pg.datas.size()) return -1;
+    scratch[i].datas.assign(pg.datas.begin() + rel,
+                            pg.datas.begin() + rel + n);
+    scratch[i].terms.assign(pg.terms.begin() + rel,
+                            pg.terms.begin() + rel + n);
+  }
+  for (uint32_t i = 0; i < n_mirrors; ++i) {
+    Wal* w = static_cast<Wal*>(wals[peer[i]]);
+    Plog* dp = static_cast<Plog*>(plogs[peer[i]]);
+    uint32_t n = counts[i];
+    std::lock_guard<std::mutex> lw(w->mu);
+    std::lock_guard<std::mutex> lp(dp->mu);
+    PlogGroup& pg = dp->groups[groups[i]];
+    int64_t rel = int64_t(starts[i]) - 1 - int64_t(pg.start);
+    std::vector<uint8_t> body;
+    size_t buf0 = w->buf.size();
+    for (uint32_t k = 0; k < n; ++k) {
+      const std::string& d = scratch[i].datas[k];
+      wal_entry_locked(w, body, groups[i], starts[i] + k,
+                       scratch[i].terms[k],
+                       reinterpret_cast<const uint8_t*>(d.data()),
+                       uint32_t(d.size()));
+      int64_t pos = rel + int64_t(k);
+      if (pos < 0) continue;
+      if (pos < int64_t(pg.datas.size())) {
+        pg.datas[size_t(pos)] = d;
+        pg.terms[size_t(pos)] = scratch[i].terms[k];
+      } else if (pos == int64_t(pg.datas.size())) {
+        pg.datas.push_back(d);
+        pg.terms.push_back(scratch[i].terms[k]);
+      } else {
+        return -1;
+      }
+    }
+    // Framed-byte accounting from actual buffer growth (no layout
+    // constant to drift from the Python struct definitions).
+    if (per_peer_bytes) per_peer_bytes[peer[i]] += w->buf.size() - buf0;
+    int64_t nl = new_lens[i];
+    if (nl >= 0) {
+      int64_t keep = nl - int64_t(pg.start);
+      if (keep < 0) keep = 0;
+      if (size_t(keep) < pg.datas.size()) {
+        pg.datas.resize(size_t(keep));
+        pg.terms.resize(size_t(keep));
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -58,11 +58,25 @@ from raftsql_tpu.core.cluster import (cluster_step_host,
 from raftsql_tpu.core.state import restore_peer_state
 from raftsql_tpu.core.step import INFO_FIELDS
 from raftsql_tpu.runtime.node import CLOSED, RAW_PLAIN
-from raftsql_tpu.storage.log import PayloadLog
-from raftsql_tpu.storage.wal import WAL, wal_exists
+from raftsql_tpu.native.build import load_native_plog
+from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
+from raftsql_tpu.storage.wal import WAL, wal_exists, wal_mirror_all
 from raftsql_tpu.utils.metrics import NodeMetrics
 
 _C = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+
+def _expand_ranges(groups, starts, counts):
+    """Per-entry (group, index) columns from per-range lists — the
+    fallback form for WAL.append_entries when a combined native call is
+    unavailable."""
+    ca = np.asarray(counts)
+    sa = np.asarray(starts)
+    offs = np.cumsum(ca) - ca
+    tot = int(ca.sum())
+    ga = np.repeat(np.asarray(groups), ca)
+    ia = np.arange(tot) - np.repeat(offs, ca) + np.repeat(sa, ca)
+    return ga, ia, ca
 
 
 class FusedClusterNode:
@@ -118,6 +132,18 @@ class FusedClusterNode:
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_active = True
+        # Native payload plane (native/wal.cc): combined WAL+payload-log
+        # C calls, OPT-IN via RAFTSQL_FUSED_NATIVE_PLOG=1.  Measured on
+        # the Python-consumer stack it LOSES to the columnar Python
+        # payload log (104k vs 239k commits/s at G=1000/E=32): the C
+        # store must materialize fresh bytes objects on every publish,
+        # while the Python store hands the consumer the very objects it
+        # already holds.  It wins only once the apply plane itself is
+        # C++-resident (reads bytes in place) — kept for that path, and
+        # every call site degrades per-call to the Python forms.
+        self._plog_lib = (load_native_plog()
+                          if os.environ.get("RAFTSQL_FUSED_NATIVE_PLOG")
+                          == "1" else None)
 
         states = []
         for p in range(P):
@@ -128,7 +154,9 @@ class FusedClusterNode:
                 os.makedirs(d, exist_ok=True)
                 self.wals.append(WAL(d,
                                      segment_bytes=cfg.wal_segment_bytes))
-                self.plogs.append(PayloadLog(G))
+                self.plogs.append(
+                    NativePayloadLog(G, self._plog_lib)
+                    if self._plog_lib is not None else PayloadLog(G))
                 states.append(None)
             # Replay-complete sentinel, replayed-or-not (the reference's
             # nil on commitC, raft.go:131-132).
@@ -152,7 +180,9 @@ class FusedClusterNode:
         published to its commit stream."""
         logs = WAL.replay(d)
         self.wals.append(WAL(d, segment_bytes=self.cfg.wal_segment_bytes))
-        plog = PayloadLog(self.cfg.num_groups)
+        plog = (NativePayloadLog(self.cfg.num_groups, self._plog_lib)
+                if self._plog_lib is not None
+                else PayloadLog(self.cfg.num_groups))
         self.plogs.append(plog)
         log_terms: Dict[int, list] = {}
         hard: Dict[int, tuple] = {}
@@ -312,132 +342,170 @@ class FusedClusterNode:
             t2b = _t.monotonic()
         else:
             t2b = t2
-        pinfo = np.asarray(jax.device_get(pinfo_dev))     # [P, G, NCOLS]
-        dev_busy = bool(busy_dev) if busy_dev is not None else True
+        if busy_dev is not None:
+            pinfo, dev_busy = jax.device_get((pinfo_dev, busy_dev))
+            pinfo = np.asarray(pinfo)
+            dev_busy = bool(dev_busy)
+        else:
+            pinfo = np.asarray(jax.device_get(pinfo_dev))  # [P,G,NCOLS]
+            dev_busy = True
         t3 = _t.monotonic()
 
         self._hints = pinfo[0, :, _C["leader_hint"]]
 
-        # Phase 1: mirror READS for every follower-accepted append, all
-        # peers, before any payload-log write of this tick.
-        mirrors: List[Tuple[int, int, int, int, list, list]] = []
+        # Phase 1: collect mirror METADATA (peer, src, group, start,
+        # count, new_len) — no reads here.  Mirror-source staging (the
+        # same-tick truncation hazard in the module doc) happens inside
+        # phase 2b, AFTER phase 2a's leader appends; that is safe
+        # because 2a writes are pure TAIL appends at positions strictly
+        # above any mirrored range (a mirror range was composed from
+        # the source's ring at end of t-1, so it ends at or below the
+        # source's t-1 length), and the only same-tick writes that can
+        # truncate or overwrite a mirrored range are OTHER MIRRORS —
+        # which both 2b paths stage fully before writing.  Any future
+        # 2a change that is not a pure tail append breaks this
+        # argument and must move 2a after 2b's staging.
+        m_peer: List[int] = []
+        m_src: List[int] = []
+        m_g: List[int] = []
+        m_start: List[int] = []
+        m_count: List[int] = []
+        m_newlen: List[int] = []
         for p in range(P):
             col = pinfo[p]
             accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
             if not accepted.size:
                 continue
             sub = col[accepted]
-            for g, src, start, n, new_len in zip(
-                    accepted.tolist(),
-                    sub[:, _C["app_from"]].tolist(),
-                    sub[:, _C["app_start"]].tolist(),
-                    sub[:, _C["app_n"]].tolist(),
-                    sub[:, _C["new_log_len"]].tolist()):
-                terms, datas = self.plogs[src].slice_columns(
-                    g, start, n) if n else ([], [])
-                mirrors.append((p, g, start, new_len, terms, datas))
+            m_peer.extend([p] * accepted.size)
+            m_g.extend(accepted.tolist())
+            m_src.extend(sub[:, _C["app_from"]].tolist())
+            m_start.extend(sub[:, _C["app_start"]].tolist())
+            m_count.extend(sub[:, _C["app_n"]].tolist())
+            m_newlen.extend(sub[:, _C["new_log_len"]].tolist())
 
-        # Phase 2: WAL + payload-log writes, then one fsync per peer.
-        tick_active = bool(mirrors)
-        # Record building is vectorized: per-entry group/index/term
-        # columns come from numpy repeat/arange over the per-group
-        # counts; Python touches each GROUP once, each entry's bytes
-        # ride list extends.
+        # Phase 2a: leader appends (fresh-leader no-ops + accepted
+        # proposals) as uniform-term RANGES per peer: one combined
+        # native call writes the WAL records and the payload-log range
+        # (wal.append_ranges_uniform); the fallback expands ranges to
+        # per-entry numpy columns for the classic two-call path.
+        tick_active = bool(m_peer)
         for p in range(P):
             col = pinfo[p]
             noop = col[:, _C["noop"]]
             acc = col[:, _C["prop_accepted"]]
             base = col[:, _C["prop_base"]]
             term = col[:, _C["term"]]
-            parts_g: List[np.ndarray] = []
-            parts_i: List[np.ndarray] = []
-            parts_t: List[np.ndarray] = []
+            r_g: List[int] = []
+            r_start: List[int] = []
+            r_count: List[int] = []
+            r_term: List[int] = []
             w_d: List[bytes] = []
-            puts: List[tuple] = []
             ngs = np.nonzero(noop)[0]
             if ngs.size:
-                # Fresh-leader no-ops: one empty record at prop_base
+                # One empty record at prop_base per fresh leader
                 # (ordered before any accepted proposals of the same
                 # group — base < base+1, both pure tail appends).
-                parts_g.append(ngs)
-                parts_i.append(base[ngs])
-                parts_t.append(term[ngs])
+                r_g.extend(ngs.tolist())
+                r_start.extend(base[ngs].tolist())
+                r_count.extend([1] * ngs.size)
+                r_term.extend(term[ngs].tolist())
                 w_d.extend([b""] * ngs.size)
-                for g in ngs.tolist():
-                    puts.append((g, int(base[g]), [b""],
-                                 [int(term[g])], None))
             ags = np.nonzero(acc > 0)[0]
             if ags.size:
-                counts = acc[ags]
-                starts = base[ags] + 1
-                tot = int(counts.sum())
-                offs = np.cumsum(counts) - counts
-                parts_g.append(np.repeat(ags, counts))
-                parts_i.append(np.arange(tot)
-                               - np.repeat(offs, counts)
-                               + np.repeat(starts, counts))
-                parts_t.append(np.repeat(term[ags], counts))
-                # One bulk tolist per column: python-int indexing in the
-                # loop beats a numpy scalar read + int() per field.
                 props_p = self._props[p]
                 with self._prop_lock:   # pops race client-thread extends
                     for g, n, b0, tm in zip(ags.tolist(),
-                                            counts.tolist(),
-                                            starts.tolist(),
+                                            acc[ags].tolist(),
+                                            (base[ags] + 1).tolist(),
                                             term[ags].tolist()):
                         q = props_p[g]
                         batch = q[:n]
                         del q[:n]
                         w_d.extend(batch)
-                        puts.append((g, b0, batch, [tm] * n, None))
-                self.metrics.proposals += tot
-            # Mirrors last: their content was read in phase 1, so order
-            # only decides which write wins a conflicting suffix — the
-            # device's accept decision (the mirror) must win.  An
-            # empty-ents mirror still carries its new_len truncation.
-            # Python collects per-GROUP lists; the per-entry columns are
-            # one repeat/arange construction at the end (per-group numpy
-            # allocs lost to plain list extends at E-sized blocks).
-            m_g: List[int] = []
-            m_start: List[int] = []
-            m_count: List[int] = []
-            m_terms: List[int] = []
-            for (mp, g, start, new_len, terms, datas) in mirrors:
-                if mp != p:
-                    continue
-                if datas:
-                    m_g.append(g)
-                    m_start.append(start)
-                    m_count.append(len(datas))
-                    m_terms.extend(terms)
-                    w_d.extend(datas)
-                puts.append((g, start, datas, terms, new_len))
-            if m_g:
-                counts = np.asarray(m_count)
-                starts = np.asarray(m_start)
-                tot = int(counts.sum())
-                offs = np.cumsum(counts) - counts
-                parts_g.append(np.repeat(np.asarray(m_g), counts))
-                parts_i.append(np.arange(tot)
-                               - np.repeat(offs, counts)
-                               + np.repeat(starts, counts))
-                parts_t.append(np.asarray(m_terms))
-            if puts:
+                        r_g.append(g)
+                        r_start.append(b0)
+                        r_count.append(n)
+                        r_term.append(tm)
+                self.metrics.proposals += int(acc[ags].sum())
+            if not r_g:
+                continue
+            tick_active = True
+            plog_native = (self.plogs[p]
+                           if hasattr(self.plogs[p], "handle") else None)
+            wrote = False
+            if plog_native is not None:
+                blob = b"".join(w_d)
+                lens = np.fromiter(map(len, w_d), np.uint32, len(w_d))
+                wrote = self.wals[p].append_ranges_uniform(
+                    plog_native, r_g, r_start, r_count, r_term, blob,
+                    lens)
+            if not wrote:
+                # Python path: expand ranges to per-entry columns.
+                ga, ia, counts = _expand_ranges(r_g, r_start, r_count)
+                ta = np.repeat(np.asarray(r_term), counts)
+                self.wals[p].append_entries(ga, ia, ta, w_d)
+                puts = []
+                pos = 0
+                for g, s, c, tm in zip(r_g, r_start, r_count, r_term):
+                    puts.append((g, s, w_d[pos: pos + c], [tm] * c,
+                                 None))
+                    pos += c
                 self.plogs[p].put_ranges(puts)
-            hs = np.stack([term, col[:, _C["voted_for"]],
+
+        # Phase 2b: follower mirrors, whole cluster in one native call
+        # (read-all-then-write-all inside C); the fallback performs the
+        # same two passes in Python — every source read happens before
+        # any mirror write, so a same-tick truncation cannot tear one.
+        if m_peer:
+            if not wal_mirror_all(self.wals, self.plogs, m_peer, m_src,
+                                  m_g, m_start, m_count, m_newlen):
+                # Python two-pass fallback: ALL source reads first (the
+                # staging contract), then one batched write per peer.
+                reads = [self.plogs[s].slice_columns(g, st, c)
+                         if c else ([], [])
+                         for (s, g, st, c) in zip(m_src, m_g, m_start,
+                                                  m_count)]
+                for p in range(P):
+                    b_g: List[int] = []
+                    b_start: List[int] = []
+                    b_count: List[int] = []
+                    b_terms: List[int] = []
+                    b_d: List[bytes] = []
+                    puts = []
+                    for (mp, g, st, c, nl), (terms, datas) in zip(
+                            zip(m_peer, m_g, m_start, m_count,
+                                m_newlen), reads):
+                        if mp != p:
+                            continue
+                        puts.append((g, st, datas, terms, nl))
+                        if c:
+                            b_g.append(g)
+                            b_start.append(st)
+                            b_count.append(c)
+                            b_terms.extend(terms)
+                            b_d.extend(datas)
+                    if puts:
+                        self.plogs[p].put_ranges(puts)
+                    if b_g:
+                        ga, ia, _ = _expand_ranges(b_g, b_start, b_count)
+                        self.wals[p].append_entries(
+                            ga, ia, np.asarray(b_terms), b_d)
+
+        # Phase 2c: hard states (after every ENTRY record of the tick —
+        # etcd wal.Save order: a torn tail can then never leave a hard
+        # state referencing lost entries), then the per-peer fsync that
+        # is the durable barrier before the next dispatch.
+        for p in range(P):
+            col = pinfo[p]
+            hs = np.stack([col[:, _C["term"]], col[:, _C["voted_for"]],
                            col[:, _C["commit"]]], axis=1)
             changed = np.nonzero((hs != self._hard[p]).any(axis=1))[0]
-            if parts_g:
-                self.wals[p].append_entries(np.concatenate(parts_g),
-                                            np.concatenate(parts_i),
-                                            np.concatenate(parts_t),
-                                            w_d)
             if changed.size:
                 self.wals[p].set_hardstates(changed, hs[changed, 0],
                                             hs[changed, 1],
                                             hs[changed, 2])
                 self._hard[p][changed] = hs[changed]
-            if parts_g or changed.size:
                 tick_active = True
             self.wals[p].sync()          # the durable barrier, per peer
         t4 = _t.monotonic()
@@ -464,19 +532,34 @@ class FusedClusterNode:
             col = pinfo[p]
             commit = col[:, _C["commit"]]
             ready = np.nonzero(commit > self._applied[p])[0]
-            for g in ready.tolist():
-                c = int(commit[g])
-                a = int(self._applied[p][g])
-                datas = self.plogs[p].slice(g, a + 1, c - a)
-                if len(datas) != c - a:
-                    raise RuntimeError(
-                        f"peer {p} g{g}: payload log shorter than "
-                        f"commit ({a}+{len(datas)} < {c})")
-                if any(datas):
-                    self._commit_qs[p].put((RAW_PLAIN, g, a, datas))
-                self._applied[p][g] = c
-                if p == 0:
-                    self.metrics.commits += c - a
+            if not ready.size:
+                continue
+            plog = self.plogs[p]
+            q = self._commit_qs[p]
+            gl = ready.tolist()
+            cl = commit[ready].tolist()
+            al = self._applied[p][ready].tolist()
+            if hasattr(plog, "read_groups"):
+                # Native plog: every ready range in TWO ctypes calls.
+                per_range = plog.read_groups(
+                    gl, [a + 1 for a in al],
+                    [c - a for c, a in zip(cl, al)])
+                for g, a, c, datas in zip(gl, al, cl, per_range):
+                    if any(datas):
+                        q.put((RAW_PLAIN, g, a, datas))
+            else:
+                for g, a, c in zip(gl, al, cl):
+                    datas = plog.slice(g, a + 1, c - a)
+                    if len(datas) != c - a:
+                        raise RuntimeError(
+                            f"peer {p} g{g}: payload log shorter than "
+                            f"commit ({a}+{len(datas)} < {c})")
+                    if any(datas):
+                        q.put((RAW_PLAIN, g, a, datas))
+            self._applied[p][ready] = commit[ready]
+            if p == 0:
+                self.metrics.commits += int(
+                    (commit[ready] - np.asarray(al)).sum())
 
     # -- log compaction (SURVEY §5.4) -----------------------------------
 
@@ -535,6 +618,9 @@ class FusedClusterNode:
             self._pending_pinfo = None
         for w in self.wals:
             w.close()
+        for plog in self.plogs:
+            if hasattr(plog, "close"):
+                plog.close()
         for q in self._commit_qs:
             q.put(CLOSED)
 

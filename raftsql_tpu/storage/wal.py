@@ -136,6 +136,62 @@ class _SegStats:
         return set(self.max_idx) | self.hs
 
 
+def wal_mirror_all(wals, plogs, peers, srcs, groups, starts, counts,
+                   new_lens) -> bool:
+    """Cluster-wide follower mirror in ONE native call
+    (walplog_mirror_all): phase A stages every source range (the
+    read-all-before-write-all contract that makes same-tick source
+    truncation safe), phase B writes each destination peer's WAL ENTRY
+    records + payload-log range + truncation.  Returns False when the
+    native path is unavailable on any peer (caller falls back)."""
+    if not wals:
+        return True
+    lib = wals[0]._lib
+    if lib is None or not hasattr(lib, "walplog_mirror_all"):
+        return False
+    if any(w._lib is None for w in wals) \
+            or any(not hasattr(p, "handle") for p in plogs):
+        return False
+    import ctypes
+
+    import numpy as np
+    n = len(peers)
+    if n == 0:
+        return True
+    P = len(wals)
+    wh = (ctypes.c_void_p * P)(*[w._h for w in wals])
+    ph = (ctypes.c_void_p * P)(*[p.handle for p in plogs])
+    pa = np.asarray(peers, np.uint32)
+    sa = np.asarray(srcs, np.uint32)
+    ga = np.asarray(groups, np.uint32)
+    ia = np.asarray(starts, np.uint64)
+    ca = np.asarray(counts, np.uint32)
+    na = np.asarray(new_lens, np.int64)
+    per_bytes = np.zeros(P, np.uint64)
+    rc = lib.walplog_mirror_all(
+        wh, ph, n,
+        pa.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        sa.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ia.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        na.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        per_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    if rc != 0:
+        raise ValueError("walplog_mirror_all: source range unavailable")
+    for i in range(n):
+        c = int(ca[i])
+        if c:
+            wals[int(pa[i])]._active_stats.bump(
+                int(ga[i]), int(ia[i]) + c - 1)
+    for p in range(P):
+        b = int(per_bytes[p])
+        if b:
+            wals[p]._pending = True
+            wals[p]._bytes += b
+    return True
+
+
 def wal_exists(dirname: str) -> bool:
     return bool(_segment_paths(dirname))
 
@@ -281,6 +337,47 @@ class WAL:
             la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
         self._pending = True
         self._bytes += n * (_HDR.size + _ENTRY.size) + len(blob)
+
+    def append_ranges_uniform(self, plog, groups, starts, counts, terms,
+                              blob: bytes, lens) -> bool:
+        """Combined native write (walplog_put_uniform): for each range
+        (group, start, count, term) write the WAL ENTRY records AND the
+        native payload-log range, all in one C call — zero per-entry
+        Python.  `blob` concatenates every range's payload bytes in
+        order; `lens` is per-entry.  Returns False when the native
+        combined path is unavailable (caller falls back to
+        append_entries + plog.put_ranges)."""
+        if self._lib is None or plog is None \
+                or not hasattr(self._lib, "walplog_put_uniform"):
+            return False
+        import ctypes
+
+        import numpy as np
+        n_ranges = len(groups)
+        if n_ranges == 0:
+            return True
+        ga = np.asarray(groups, np.uint32)
+        sa = np.asarray(starts, np.uint64)
+        ca = np.asarray(counts, np.uint32)
+        ta = np.asarray(terms, np.uint64)
+        la = np.asarray(lens, np.uint32)
+        rc = self._lib.walplog_put_uniform(
+            self._h, plog.handle, n_ranges,
+            ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            sa.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ta.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            blob,
+            la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        if rc != 0:
+            raise ValueError("walplog_put_uniform: payload gap")
+        bump = self._active_stats.bump
+        for g, s, c in zip(ga.tolist(), sa.tolist(), ca.tolist()):
+            bump(g, s + c - 1)
+        self._pending = True
+        self._bytes += int(ca.sum()) * (_HDR.size + _ENTRY.size) \
+            + len(blob)
+        return True
 
     def set_hardstate(self, group: int, term: int, vote: int,
                       commit: int) -> None:
