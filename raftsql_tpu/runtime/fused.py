@@ -509,16 +509,25 @@ class FusedClusterNode:
                 tick_active = True
             self.wals[p].sync()          # the durable barrier, per peer
         t4 = _t.monotonic()
-        self._pending_pinfo = pinfo
         # Quiescence signal for the threaded loop: anything written,
-        # any commit not yet published, any group leaderless, or any
-        # proposal backlog means "keep ticking at full pace".
-        self._tick_active = (tick_active
-                             or dev_busy
-                             or bool((pinfo[:, :, _C["commit"]]
-                                      > self._applied).any())
-                             or bool((self._hints < 0).any())
-                             or bool(self._queued))
+        # any group leaderless, or any proposal backlog means "keep
+        # ticking at full pace".
+        base_active = (tick_active
+                       or dev_busy
+                       or bool((self._hints < 0).any())
+                       or bool(self._queued))
+        if base_active:
+            self._pending_pinfo = pinfo      # next tick overlaps it
+        else:
+            # About to go quiet: deliver this tick's commits NOW (they
+            # are fsynced above) instead of deferring to a next tick
+            # that may be a parked 0.5s away — the deferral only pays
+            # when another dispatch immediately follows to overlap.
+            self._publish(pinfo)
+            self._pending_pinfo = None
+            t5 = _t.monotonic()
+            self.metrics.t_publish_ms += (t5 - t4) * 1e3
+        self._tick_active = base_active
         self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
         self.metrics.t_publish_ms += (t2 - t1) * 1e3
         self.metrics.t_wal_ms += (t4 - t3) * 1e3

@@ -1,0 +1,47 @@
+"""The distributed runtime's event loop (runtime/node.py _run).
+
+Covers step elision: interval-paced wakeups accumulate timer advance
+without stepping while the device-reported timer_margin says no
+election/heartbeat can fire, and the work event resumes full service
+immediately.
+"""
+import time
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.runtime.node import RaftNode
+from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+
+
+def test_threaded_node_elides_idle_steps(tmp_path):
+    """An idle threaded
+    node with a coarse heartbeat runs far fewer steps than the tick
+    interval allows — the device-reported timer_margin parks the loop —
+    yet keeps serving when work arrives (the work event)."""
+    cfg = RaftConfig(num_groups=1, num_peers=1, tick_interval_s=0.002,
+                     election_ticks=60, heartbeat_ticks=25,
+                     log_window=32, max_entries_per_msg=4)
+    n = RaftNode(1, 1, cfg, LoopbackTransport(LoopbackHub()),
+                 data_dir=str(tmp_path / "n1"))
+    n.start(threaded=True)
+    try:
+        deadline = time.monotonic() + 5
+        while n.leader_of(0) < 0:
+            assert time.monotonic() < deadline, "no self-election"
+            time.sleep(0.01)
+        n.metrics.ticks = 0
+        time.sleep(1.0)
+        idle_ticks = n.metrics.ticks
+        # 1s / 2ms = 500 loop slots; a leader's margin is the heartbeat
+        # countdown (25), so ~20 steps expected.  Allow generous slack
+        # for CI scheduling; the pre-elision loop would run ~400+.
+        assert idle_ticks <= 120, idle_ticks
+        # Snapshot first: the new leader's no-op already counts as a
+        # commit, so waiting for >= 1 would pass vacuously.
+        base = n.metrics.commits
+        n.propose(0, b"SET k v")
+        deadline = time.monotonic() + 5
+        while n.metrics.commits <= base:
+            assert time.monotonic() < deadline, "proposal never committed"
+            time.sleep(0.01)
+    finally:
+        n.stop()
