@@ -1467,7 +1467,10 @@ def main() -> None:
                        "BENCH_E": os.environ.get("BENCH_E", "32")},
             label="durable-tpu-fused")
 
-    # -- 3. durable-path child (host runtime measured on cpu).
+    # -- 3. durable-path children (host runtime measured on cpu):
+    # the per-peer RaftNode mode (history-comparable) and the fused
+    # one-dispatch mode (the round-5 headline shape) — both recorded
+    # even when the device is unreachable.
     durable = None
     if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
             and remaining() > fallback_reserve + 120:
@@ -1476,6 +1479,15 @@ def main() -> None:
             extra_env={"BENCH_CONFIG": "durable",
                        "BENCH_DURABLE_MODE": "node"},
             label="durable-cpu")
+    durable_fused = None
+    if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
+            and remaining() > fallback_reserve + 120:
+        durable_fused = _attempt(
+            "cpu", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "durable",
+                       "BENCH_DURABLE_MODE": "fused",
+                       "BENCH_E": os.environ.get("BENCH_E", "32")},
+            label="durable-cpu-fused")
 
     # -- 3a'. end-to-end HTTP child (BASELINE config 1): the 3-process
     # Procfile cluster over real HTTP PUT/GET — the one configuration
@@ -1582,6 +1594,11 @@ def main() -> None:
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
             parsed["durable_sm"] = durable.get("durable_sm")
+        if durable_fused:
+            parsed["durable_fused_commits_per_s"] = \
+                durable_fused.get("value")
+            parsed["durable_fused_tick_ms"] = \
+                durable_fused.get("durable_tick_ms")
         if durable_tpu:
             parsed["durable_tpu_commits_per_s"] = durable_tpu.get("value")
             parsed["durable_tpu_tick_ms"] = \
@@ -1591,8 +1608,9 @@ def main() -> None:
             parsed["durable_tpu_sm"] = durable_tpu.get("durable_sm")
         if httpc:
             parsed["http_req_per_s"] = httpc.get("value")
-            parsed["http_lat"] = httpc.get("http_lat")
-            parsed["http_lat_hi"] = httpc.get("http_lat_hi")
+            for k in ("http_lat", "http_lat_hi", "http_lat_fused",
+                      "http_lat_fused_hi"):
+                parsed[k] = httpc.get(k)
             parsed["http_cpu_count"] = httpc.get("cpu_count")
         _emit(parsed)
         return
@@ -1613,10 +1631,16 @@ def main() -> None:
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
             parsed["durable_sm"] = durable.get("durable_sm")
+        if durable_fused:
+            parsed["durable_fused_commits_per_s"] = \
+                durable_fused.get("value")
+            parsed["durable_fused_tick_ms"] = \
+                durable_fused.get("durable_tick_ms")
         if httpc:
             parsed["http_req_per_s"] = httpc.get("value")
-            parsed["http_lat"] = httpc.get("http_lat")
-            parsed["http_lat_hi"] = httpc.get("http_lat_hi")
+            for k in ("http_lat", "http_lat_hi", "http_lat_fused",
+                      "http_lat_fused_hi"):
+                parsed[k] = httpc.get(k)
             parsed["http_cpu_count"] = httpc.get("cpu_count")
         # Clearly-labeled history, not a headline: the newest committed
         # TPU_RUNS.jsonl entry, so a wedged tunnel leaves a citable
