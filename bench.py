@@ -1480,14 +1480,6 @@ def main() -> None:
                        "BENCH_DURABLE_MODE": "node"},
             label="durable-cpu")
     durable_fused = None
-    if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
-            and remaining() > fallback_reserve + 120:
-        durable_fused = _attempt(
-            "cpu", min(timeout_s, remaining() - fallback_reserve),
-            extra_env={"BENCH_CONFIG": "durable",
-                       "BENCH_DURABLE_MODE": "fused",
-                       "BENCH_E": os.environ.get("BENCH_E", "32")},
-            label="durable-cpu-fused")
 
     # -- 3a'. end-to-end HTTP child (BASELINE config 1): the 3-process
     # Procfile cluster over real HTTP PUT/GET — the one configuration
@@ -1539,6 +1531,18 @@ def main() -> None:
                  f"{ {g: round(r['value'], 1) for g, r in results.items()} }"
                  f" faults {faults}")
 
+    # -- 3a''. fused durable on cpu (the round-5 headline shape) —
+    # AFTER the late re-probe so a recoverable TPU headline always
+    # outranks this secondary CPU rung in the budget.
+    if os.environ.get("BENCH_SKIP_DURABLE") != "1" \
+            and remaining() > fallback_reserve + 120:
+        durable_fused = _attempt(
+            "cpu", min(timeout_s, remaining() - fallback_reserve),
+            extra_env={"BENCH_CONFIG": "durable",
+                       "BENCH_DURABLE_MODE": "fused",
+                       "BENCH_E": os.environ.get("BENCH_E", "32")},
+            label="durable-cpu-fused")
+
     # -- 3b. latency child on the device: ONE small shape (G=1024, E=16)
     # where the 3-tick pipeline meets the <2 ms p50 target; its own
     # child so a fault cannot cost the headline and the ladder rungs
@@ -1572,6 +1576,16 @@ def main() -> None:
             label=f"rules-G{rules_g}")
 
 
+
+    def _record_durable_fused(parsed: dict) -> None:
+        if not durable_fused:
+            return
+        parsed["durable_fused_commits_per_s"] = durable_fused.get("value")
+        parsed["durable_fused_tick_ms"] = \
+            durable_fused.get("durable_tick_ms")
+        parsed["durable_fused_lat"] = durable_fused.get("durable_lat")
+        parsed["durable_fused_sm"] = durable_fused.get("durable_sm")
+
     if results:
         # Headline = best commits/s across the ladder (the throughput
         # curve peaks near G=32k and flattens; "largest G that ran" was
@@ -1594,11 +1608,7 @@ def main() -> None:
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
             parsed["durable_sm"] = durable.get("durable_sm")
-        if durable_fused:
-            parsed["durable_fused_commits_per_s"] = \
-                durable_fused.get("value")
-            parsed["durable_fused_tick_ms"] = \
-                durable_fused.get("durable_tick_ms")
+        _record_durable_fused(parsed)
         if durable_tpu:
             parsed["durable_tpu_commits_per_s"] = durable_tpu.get("value")
             parsed["durable_tpu_tick_ms"] = \
@@ -1631,11 +1641,7 @@ def main() -> None:
             parsed["durable_tick_ms"] = durable.get("durable_tick_ms")
             parsed["durable_lat"] = durable.get("durable_lat")
             parsed["durable_sm"] = durable.get("durable_sm")
-        if durable_fused:
-            parsed["durable_fused_commits_per_s"] = \
-                durable_fused.get("value")
-            parsed["durable_fused_tick_ms"] = \
-                durable_fused.get("durable_tick_ms")
+        _record_durable_fused(parsed)
         if httpc:
             parsed["http_req_per_s"] = httpc.get("value")
             for k in ("http_lat", "http_lat_hi", "http_lat_fused",
