@@ -40,3 +40,12 @@ tsan:
 clean:
 	rm -f test.out raftsql_tpu/native/_native_*.so
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+# The durable product paths, quick local shapes (one JSON line each).
+bench-durable:
+	BENCH_CHILD=1 BENCH_PLATFORM=cpu BENCH_CONFIG=durable \
+	  BENCH_DURABLE_MODE=fused BENCH_E=32 python bench.py
+
+bench-http:
+	BENCH_CHILD=1 BENCH_PLATFORM=cpu BENCH_CONFIG=http \
+	  BENCH_HTTP_SECONDS=8 python bench.py
