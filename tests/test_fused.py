@@ -255,8 +255,6 @@ def test_fused_crash_with_torn_tail_recovers(tmp_path):
     repairs the tail and the cluster serves again with the durable
     prefix intact on every peer (storage-level repair wired end to
     end)."""
-    import os as _os
-
     cfg = mkcfg(groups=2)
     node = FusedClusterNode(cfg, str(tmp_path))
     elect(node)
