@@ -42,6 +42,21 @@ from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
 
+def iter_raw_plain(item):
+    """Tuple-free expansion of a RAW_PLAIN commit item: yields
+    (index, decoded_command) for each non-empty entry.  Lives next to
+    _expand_commit_item so the RAW_PLAIN wire contract (index base,
+    empty-entry skip, utf-8 payloads) has exactly one owner; hot
+    consumers (the durable benchmark's drain) use this instead of
+    building per-entry (group, index, str) tuples."""
+    _, _, base, datas = item
+    idx = base
+    for d in datas:
+        idx += 1
+        if d:
+            yield idx, d.decode("utf-8")
+
+
 def _expand_commit_item(item, node=None):
     """Normalize a commit_q item to per-entry (group, index, sql) tuples.
 
