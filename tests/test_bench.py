@@ -94,15 +94,25 @@ def test_ledger_append_and_last_good(tmp_path, monkeypatch):
     assert got == {"platform": "tpu", "value": 3.0, "ts": "t2"}
 
 
-def test_committed_ledger_has_r3_tpu_evidence():
-    """The round-3 TPU ladder evidence must stay committed and parseable
-    (VERDICT r3 missing #1: the only TPU proof used to be a gitignored
-    stray log)."""
+def test_committed_ledger_has_tpu_evidence():
+    """On-device evidence must stay committed and parseable (VERDICT r3
+    missing #1: the only TPU proof used to be a gitignored stray log).
+    The newest entry may be any config (latency/durable children append
+    too); the headline proof just has to exist somewhere in the ledger."""
     import bench
 
     got = bench._ledger_last_good()
     assert got is not None and got["platform"] == "tpu"
-    assert got["value"] > 1e8 or got.get("rules")
+    headline = []
+    with open(bench.TPU_RUNS_PATH) as f:
+        for line in f:
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if d.get("platform") == "tpu" and d.get("config") == "headline":
+                headline.append(d)
+    assert any(d.get("value", 0) > 1e8 for d in headline)
 
 
 def test_parent_emits_json_when_all_attempts_fail():
