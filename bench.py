@@ -921,8 +921,9 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         sms = [KVStateMachine() for _ in range(groups)]
         mk_cmd = None                      # kv: unique keys per batch
 
-    from raftsql_tpu.runtime.db import iter_raw_plain
-    from raftsql_tpu.runtime.node import RAW_PLAIN
+    from raftsql_tpu.runtime.db import (iter_plain_batches,
+                                        iter_plain_entries)
+    from raftsql_tpu.runtime.node import RAW_MANY, RAW_PLAIN
 
     def drain(node, apply: bool, t0q=None, lats=None) -> int:
         cnt = 0
@@ -935,18 +936,19 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                 break
             if item is None or not isinstance(item, tuple):
                 continue
-            if item[0] is RAW_PLAIN:
-                # The fused publish already batches per group: decode in
-                # place (runtime/db.py iter_raw_plain owns the contract)
-                # instead of expanding to per-entry tuples first.
-                g = item[1]
-                if apply:
-                    lst = per_g.setdefault(g, [])
-                    for idx, cmd in iter_raw_plain(item):
-                        lst.append((cmd, idx))
-                        cnt += 1
-                else:
-                    cnt += sum(1 for _ in iter_raw_plain(item))
+            if item[0] is RAW_PLAIN or item[0] is RAW_MANY:
+                # The fused publish batches per group (RAW_PLAIN) or per
+                # tick (RAW_MANY): decode in place (runtime/db.py owns
+                # the plain-payload contract) instead of expanding to
+                # per-entry tuples first.
+                for g, base, datas in iter_plain_batches(item):
+                    if apply:
+                        lst = per_g.setdefault(g, [])
+                        for idx, cmd in iter_plain_entries(base, datas):
+                            lst.append((cmd, idx))
+                            cnt += 1
+                    else:
+                        cnt += sum(1 for d in datas if d)
                 continue
             for g, idx, cmd in _expand_commit_item(item):
                 if apply:

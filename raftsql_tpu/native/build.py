@@ -156,6 +156,14 @@ def load_native_wal():
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32)]
+        lib.wal_append_ranges.restype = ctypes.c_int
+        lib.wal_append_ranges.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32)]
         lib.wal_set_hardstate.restype = ctypes.c_int
         lib.wal_set_hardstate.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,

@@ -37,24 +37,36 @@ from typing import Callable, Dict, Optional, Tuple
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
 from raftsql_tpu.runtime.envelope import unwrap
-from raftsql_tpu.runtime.node import CLOSED, RAW_BATCH, RAW_PLAIN
+from raftsql_tpu.runtime.node import (CLOSED, RAW_BATCH, RAW_MANY,
+                                      RAW_PLAIN)
 from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
 
 
-def iter_raw_plain(item):
-    """Tuple-free expansion of a RAW_PLAIN commit item: yields
-    (index, decoded_command) for each non-empty entry.  Lives next to
-    _expand_commit_item so the RAW_PLAIN wire contract (index base,
+def iter_plain_entries(base, datas):
+    """Yield (index, decoded_command) for each non-empty entry of one
+    plain-payload sub-batch (entries at base+1..).  Lives next to
+    _expand_commit_item so the plain wire contract (index base,
     empty-entry skip, utf-8 payloads) has exactly one owner; hot
     consumers (the durable benchmark's drain) use this instead of
     building per-entry (group, index, str) tuples."""
-    _, _, base, datas = item
     idx = base
     for d in datas:
         idx += 1
         if d:
             yield idx, d.decode("utf-8")
+
+
+def iter_plain_batches(item):
+    """Yield (group, base_idx, [raw_bytes, ...]) sub-batches of a
+    plain-payload commit item — one batch for RAW_PLAIN, the whole
+    tick's batches for RAW_MANY.  Same single-owner rationale as
+    iter_plain_entries; payloads follow the plain contract (no
+    envelopes, empty bytes = no-op entries the consumer skips)."""
+    if item[0] is RAW_PLAIN:
+        yield item[1], item[2], item[3]
+    elif item[0] is RAW_MANY:
+        yield from item[1]
 
 
 def _expand_commit_item(item, node=None):
@@ -74,6 +86,9 @@ def _expand_commit_item(item, node=None):
         fused/mesh runtimes, which route proposals on the host).
         Tagging wrapped payloads RAW_PLAIN would apply entries with
         envelope header bytes prepended;
+      - (RAW_MANY, [(group, base_idx, [raw_bytes, ...]), ...]) — a
+        whole fused tick's RAW_PLAIN batches in one queue item (same
+        plain-payload contract);
       - (group, index, sql_str) — WAL replay per-entry items (the
         nil-sentinel counting protocol must stay item-accurate there);
       - (group, [(index, sql), ...]) — decoded per-group batches (older
@@ -95,6 +110,10 @@ def _expand_commit_item(item, node=None):
     if item[0] is RAW_PLAIN:
         _, g, base, datas = item
         return [(g, base + 1 + off, data.decode("utf-8"))
+                for off, data in enumerate(datas) if data]
+    if item[0] is RAW_MANY:
+        return [(g, base + 1 + off, data.decode("utf-8"))
+                for (g, base, datas) in item[1]
                 for off, data in enumerate(datas) if data]
     if len(item) == 2:
         g = item[0]

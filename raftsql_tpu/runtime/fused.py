@@ -57,7 +57,7 @@ from raftsql_tpu.core.cluster import (cluster_step_host,
                                       init_cluster_state)
 from raftsql_tpu.core.state import restore_peer_state
 from raftsql_tpu.core.step import INFO_FIELDS
-from raftsql_tpu.runtime.node import CLOSED, RAW_PLAIN
+from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
 from raftsql_tpu.native.build import load_native_plog
 from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
 from raftsql_tpu.storage.wal import WAL, wal_exists, wal_mirror_all
@@ -87,7 +87,8 @@ class FusedClusterNode:
     `tick()` advances the whole cluster one step, `commit_q(peer)` is
     that peer's totally-ordered commit stream (same item protocol as
     RaftNode: any replayed (RAW_PLAIN, g, base, [bytes...]) batches
-    first, then the None replay-complete sentinel, then live batches;
+    first, then the None replay-complete sentinel, then live ticks as
+    (RAW_MANY, [(g, base, [bytes...]), ...]) batch-of-batches items;
     CLOSED ends the stream), `leader_of(group)` reports the last hint.
     """
 
@@ -132,6 +133,12 @@ class FusedClusterNode:
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_active = True
+        # One worker per peer for the end-of-tick durable barrier: the
+        # P per-peer fsyncs overlap (independent files; fsync releases
+        # the GIL), so the barrier costs max not sum of the fsyncs.
+        from concurrent.futures import ThreadPoolExecutor
+        self._sync_pool = ThreadPoolExecutor(
+            max_workers=P, thread_name_prefix="wal-sync")
         # Native payload plane (native/wal.cc): combined WAL+payload-log
         # C calls, OPT-IN via RAFTSQL_FUSED_NATIVE_PLOG=1.  Measured on
         # the Python-consumer stack it LOSES to the columnar Python
@@ -441,10 +448,10 @@ class FusedClusterNode:
                     plog_native, r_g, r_start, r_count, r_term, blob,
                     lens)
             if not wrote:
-                # Python path: expand ranges to per-entry columns.
-                ga, ia, counts = _expand_ranges(r_g, r_start, r_count)
-                ta = np.repeat(np.asarray(r_term), counts)
-                self.wals[p].append_entries(ga, ia, ta, w_d)
+                # Python plog path: RANGE records — one framed record
+                # per (group, start, term) run, not one per entry.
+                self.wals[p].append_ranges(r_g, r_start, r_count,
+                                           r_term, w_d)
                 puts = []
                 pos = 0
                 for g, s, c, tm in zip(r_g, r_start, r_count, r_term):
@@ -488,9 +495,31 @@ class FusedClusterNode:
                     if puts:
                         self.plogs[p].put_ranges(puts)
                     if b_g:
-                        ga, ia, _ = _expand_ranges(b_g, b_start, b_count)
-                        self.wals[p].append_entries(
-                            ga, ia, np.asarray(b_terms), b_d)
+                        # Mirrored batches may cross term boundaries;
+                        # RANGE records are uniform-term, so split each
+                        # mirror at its term changes (rare: elections).
+                        s_g: List[int] = []
+                        s_start: List[int] = []
+                        s_count: List[int] = []
+                        s_term: List[int] = []
+                        pos = 0
+                        for g, st0, c in zip(b_g, b_start, b_count):
+                            terms = b_terms[pos: pos + c]
+                            run0 = 0
+                            for i in range(1, c):
+                                if terms[i] != terms[run0]:
+                                    s_g.append(g)
+                                    s_start.append(st0 + run0)
+                                    s_count.append(i - run0)
+                                    s_term.append(terms[run0])
+                                    run0 = i
+                            s_g.append(g)
+                            s_start.append(st0 + run0)
+                            s_count.append(c - run0)
+                            s_term.append(terms[run0])
+                            pos += c
+                        self.wals[p].append_ranges(s_g, s_start, s_count,
+                                                   s_term, b_d)
 
         # Phase 2c: hard states (after every ENTRY record of the tick —
         # etcd wal.Save order: a torn tail can then never leave a hard
@@ -507,7 +536,13 @@ class FusedClusterNode:
                                             hs[changed, 2])
                 self._hard[p][changed] = hs[changed]
                 tick_active = True
-            self.wals[p].sync()          # the durable barrier, per peer
+        # The durable barrier: every peer fsynced before this tick's
+        # messages can be observed (the next dispatch).  The P fsyncs
+        # are independent files — run them concurrently (os.fsync and
+        # the native wal_sync both release the GIL), so the barrier
+        # costs one fsync wall-time, not P.  A peer with nothing
+        # pending returns immediately.
+        list(self._sync_pool.map(lambda w: w.sync(), self.wals))
         t4 = _t.monotonic()
         # Quiescence signal for the threaded loop: anything written,
         # any group leaderless, or any proposal backlog means "keep
@@ -536,7 +571,8 @@ class FusedClusterNode:
 
     def _publish(self, pinfo: np.ndarray) -> None:
         """Deliver a saved tick's newly committed entries to each peer's
-        commit stream (they were fsynced before this runs)."""
+        commit stream (they were fsynced before this runs) — the whole
+        tick as ONE RAW_MANY queue item per peer."""
         for p in range(self.cfg.num_peers):
             col = pinfo[p]
             commit = col[:, _C["commit"]]
@@ -544,27 +580,30 @@ class FusedClusterNode:
             if not ready.size:
                 continue
             plog = self.plogs[p]
-            q = self._commit_qs[p]
             gl = ready.tolist()
             cl = commit[ready].tolist()
             al = self._applied[p][ready].tolist()
+            items = []
             if hasattr(plog, "read_groups"):
                 # Native plog: every ready range in TWO ctypes calls.
                 per_range = plog.read_groups(
                     gl, [a + 1 for a in al],
                     [c - a for c, a in zip(cl, al)])
-                for g, a, c, datas in zip(gl, al, cl, per_range):
+                for g, a, datas in zip(gl, al, per_range):
                     if any(datas):
-                        q.put((RAW_PLAIN, g, a, datas))
+                        items.append((g, a, datas))
             else:
+                sl = plog.slice
                 for g, a, c in zip(gl, al, cl):
-                    datas = plog.slice(g, a + 1, c - a)
+                    datas = sl(g, a + 1, c - a)
                     if len(datas) != c - a:
                         raise RuntimeError(
                             f"peer {p} g{g}: payload log shorter than "
                             f"commit ({a}+{len(datas)} < {c})")
                     if any(datas):
-                        q.put((RAW_PLAIN, g, a, datas))
+                        items.append((g, a, datas))
+            if items:
+                self._commit_qs[p].put((RAW_MANY, items))
             self._applied[p][ready] = commit[ready]
             if p == 0:
                 self.metrics.commits += int(
@@ -625,6 +664,7 @@ class FusedClusterNode:
         if self._pending_pinfo is not None:
             self._publish(self._pending_pinfo)    # already durable
             self._pending_pinfo = None
+        self._sync_pool.shutdown(wait=True)
         for w in self.wals:
             w.close()
         for plog in self.plogs:

@@ -95,6 +95,12 @@ RAW_BATCH = object()
 # Expansion skips the per-entry unwrap probe, which is a measurable
 # share of the consumer at durable-bench saturation.
 RAW_PLAIN = object()
+# A whole tick's publishes in ONE queue item:
+# (RAW_MANY, [(group, base_idx, [plain_bytes, ...]), ...]).  At G=10k
+# saturation the fused publish was one queue.put per ready group
+# (~10k/tick, ~100 ms of lock/notify traffic); batching them costs the
+# consumer one extra loop level and the producer almost nothing.
+RAW_MANY = object()
 
 
 class RaftNode:

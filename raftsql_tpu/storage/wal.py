@@ -31,6 +31,15 @@ Record layout:  u32 crc32(body) | u32 body_len | body
   type 2 HARDSTATE: u32 group | u64 term | i64 vote | u64 commit
   type 3 SNAPSHOT:  u32 group | u64 index | u64 term
   type 4 COMPACT:   u32 group | u64 index | u64 term
+  type 5 RANGE:     u32 group | u64 start | u64 term | u32 count
+                    | u32 lens[count] | bytes payloads (concatenated)
+
+RANGE is the batched form the fused tick writes: one record per
+(group, start, term) run of consecutive same-term entries at
+start .. start+count-1, with the 8-byte frame + 21-byte entry header
+amortized across the run (per-entry framing tripled the durable tick's
+fsync bytes at G=10k).  Replay expands a RANGE to exactly the entry
+sequence its per-entry form would have produced.
 
 Replay semantics match raft's log-matching property: a later ENTRY record
 at an index <= the current length with the SAME term is an idempotent
@@ -59,6 +68,7 @@ _HDR = struct.Struct("<II")          # crc, body_len
 _ENTRY = struct.Struct("<BIQQ")      # type, group, index, term
 _HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
 _SNAP = struct.Struct("<BIQQ")       # type, group, index, term (also COMPACT)
+_RANGE = struct.Struct("<BIQQI")     # type, group, start, term, count
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
@@ -66,6 +76,7 @@ REC_SNAPSHOT = 3        # install boundary: entries <= index AND the
 #                         retained suffix dropped (conflicting history)
 REC_COMPACT = 4         # compaction floor: entries <= index dropped,
 #                         retained suffix kept
+REC_RANGE = 5           # batched same-term entry run (see module doc)
 
 _SEG_RE = re.compile(r"^wal-(\d+)\.log$")
 # Single source of truth for the default lives in config (the CLI and
@@ -340,6 +351,59 @@ class WAL:
         self._pending = True
         self._bytes += n * (_HDR.size + _ENTRY.size) + len(blob)
 
+    def append_ranges(self, groups, starts, counts, terms, datas) -> None:
+        """Batched RANGE append: one type-5 record per (group, start,
+        term, count) run of consecutive same-term entries.  `datas` is
+        the flat per-entry payload list, ranges in order, `sum(counts)`
+        entries total.  Equivalent on replay to appending each entry,
+        at ~1/4 the framed bytes for small payloads (the durable tick's
+        fsync is bandwidth-bound).
+        """
+        if any(c == 0 for c in counts):
+            # Empty runs write nothing: a zero-count record would bump
+            # segment stats at start-1 for a group that may have no
+            # durable floor, permanently blocking segment deletion.
+            keep = [i for i, c in enumerate(counts) if c]
+            groups = [groups[i] for i in keep]
+            starts = [starts[i] for i in keep]
+            terms = [terms[i] for i in keep]
+            counts = [c for c in counts if c]
+        n = len(groups)
+        if n == 0:
+            return
+        import numpy as np
+        la = np.fromiter(map(len, datas), np.uint32, len(datas))
+        bump = self._active_stats.bump
+        for g, s, c in zip(groups, starts, counts):
+            bump(int(g), int(s) + int(c) - 1)
+        if self._lib is not None:
+            import ctypes
+            ga = np.asarray(groups, np.uint32)
+            sa = np.asarray(starts, np.uint64)
+            ta = np.asarray(terms, np.uint64)
+            ca = np.asarray(counts, np.uint32)
+            blob = b"".join(datas)
+            self._lib.wal_append_ranges(
+                self._h, n,
+                ga.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                sa.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ta.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                blob,
+                la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            self._pending = True
+            self._bytes += (n * (_HDR.size + _RANGE.size)
+                            + 4 * len(datas) + len(blob))
+            return
+        pos = 0
+        lens = la.tobytes()      # little-endian u32, matches the format
+        for g, s, c, t in zip(groups, starts, counts, terms):
+            body = (_RANGE.pack(REC_RANGE, g, s, t, c)
+                    + lens[4 * pos: 4 * (pos + c)]
+                    + b"".join(datas[pos: pos + c]))
+            pos += c
+            self._write(body)
+
     def append_ranges_uniform(self, plog, groups, starts, counts, terms,
                               blob: bytes, lens) -> bool:
         """Combined native write (walplog_put_uniform): for each range
@@ -518,6 +582,9 @@ class WAL:
             if rtype == REC_ENTRY:
                 _, group, index, _t = _ENTRY.unpack_from(body)
                 st.bump(group, index)
+            elif rtype == REC_RANGE:
+                _, group, start, _t, count = _RANGE.unpack_from(body)
+                st.bump(group, start + count - 1)
             elif rtype == REC_HARDSTATE:
                 st.hs.add(_HARD.unpack_from(body)[1])
             elif rtype in (REC_SNAPSHOT, REC_COMPACT):
@@ -652,6 +719,36 @@ class WAL:
         return groups
 
     @staticmethod
+    def _replay_entry(groups: Dict[int, GroupLog], group: int, index: int,
+                      term: int, data: bytes) -> None:
+        """Apply one replayed entry (ENTRY record, or one position of a
+        RANGE record) under the log-matching semantics in the module
+        doc: same-term overwrite is idempotent, different-term truncates
+        the suffix, below-floor is skipped."""
+        gl = groups.setdefault(group, GroupLog())
+        pos = index - gl.start               # 1-based within entries
+        if pos < 1:
+            return                           # below compaction floor
+        if pos <= len(gl.entries):
+            if gl.entries[pos - 1][0] == term:
+                gl.entries[pos - 1] = (term, data)
+            else:                            # conflict truncation
+                del gl.entries[pos - 1:]
+                gl.entries.append((term, data))
+        elif pos == len(gl.entries) + 1:
+            gl.entries.append((term, data))
+        else:
+            # Forward gap: the missing prefix lived in segments
+            # compaction unlinked (its COMPACT marker replays later,
+            # from a retained segment — it will confirm this floor and
+            # supply start_term).  Record-level corruption cannot
+            # produce a gap: appends are sequential within a segment
+            # and a torn record stops replay entirely.
+            gl.entries.clear()
+            gl.start, gl.start_term = index - 1, 0
+            gl.entries.append((term, data))
+
+    @staticmethod
     def _replay_blob(blob: bytes, groups: Dict[int, GroupLog]) -> bool:
         """Apply one segment's records; False on a torn record."""
         off = 0
@@ -664,30 +761,18 @@ class WAL:
             rtype = body[0]
             if rtype == REC_ENTRY:
                 _, group, index, term = _ENTRY.unpack_from(body)
-                data = body[_ENTRY.size:]
-                gl = groups.setdefault(group, GroupLog())
-                pos = index - gl.start           # 1-based within entries
-                if pos < 1:
-                    continue                     # below compaction floor
-                if pos <= len(gl.entries):
-                    if gl.entries[pos - 1][0] == term:
-                        gl.entries[pos - 1] = (term, data)
-                    else:                        # conflict truncation
-                        del gl.entries[pos - 1:]
-                        gl.entries.append((term, data))
-                elif pos == len(gl.entries) + 1:
-                    gl.entries.append((term, data))
-                else:
-                    # Forward gap: the missing prefix lived in segments
-                    # compaction unlinked (its COMPACT marker replays
-                    # later, from a retained segment — it will confirm
-                    # this floor and supply start_term).  Record-level
-                    # corruption cannot produce a gap: appends are
-                    # sequential within a segment and a torn record stops
-                    # replay entirely.
-                    gl.entries.clear()
-                    gl.start, gl.start_term = index - 1, 0
-                    gl.entries.append((term, data))
+                WAL._replay_entry(groups, group, index, term,
+                                  body[_ENTRY.size:])
+            elif rtype == REC_RANGE:
+                _, group, start, term, count = _RANGE.unpack_from(body)
+                doff = _RANGE.size + 4 * count
+                pos = doff
+                for i in range(count):
+                    (ln,) = struct.unpack_from(
+                        "<I", body, _RANGE.size + 4 * i)
+                    WAL._replay_entry(groups, group, start + i, term,
+                                      body[pos: pos + ln])
+                    pos += ln
             elif rtype == REC_HARDSTATE:
                 _, group, term, vote, commit = _HARD.unpack_from(body)
                 gl = groups.setdefault(group, GroupLog())
