@@ -907,9 +907,23 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
     tmp = tempfile.mkdtemp(prefix="bench-fused-")
     # BENCH_SM=sqlite: the reference-parity apply engine (one SQLite
     # database per group, group-committed transactions) — the FULL
-    # product stack on the fused runtime.  Default: in-memory KV.
+    # product stack on the fused runtime.  Default: the C++ KV plane
+    # (models/kv_native.py) applied straight from the native payload
+    # log — the measured fastest durable deployment (525k vs 329k
+    # commits/s at G=1000/E=32 on one CPU core).  BENCH_DURABLE_APPLY=
+    # python forces the Python-resident KV consumer; =native makes a
+    # missing toolchain an error instead of a fallback.
+    apply_req = os.environ.get("BENCH_DURABLE_APPLY", "")
+    if apply_req == "native" and os.environ.get("BENCH_SM") == "sqlite":
+        raise RuntimeError(
+            "BENCH_DURABLE_APPLY=native conflicts with BENCH_SM=sqlite "
+            "(the native plane is the KV apply engine)")
+    native_apply = (apply_req != "python"
+                    and os.environ.get("BENCH_SM") != "sqlite")
+    if native_apply:
+        os.environ["RAFTSQL_FUSED_NATIVE_PLOG"] = "1"
     sm_kind = ("sqlite" if os.environ.get("BENCH_SM") == "sqlite"
-               else "kv")     # the branch actually taken gets recorded
+               else ("kv-native" if native_apply else "kv"))
     if sm_kind == "sqlite":
         sms = [SQLiteStateMachine(os.path.join(tmp, f"sm-{g}.db"))
                for g in range(groups)]
@@ -967,6 +981,17 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         return cnt
 
     node = FusedClusterNode(cfg, tmp)
+    node.publish_peers = {0}       # the drain consumes peer 0's stream
+    kv_native = None
+    if native_apply and not hasattr(node.plogs[0], "handle"):
+        if apply_req == "native":
+            raise RuntimeError(
+                "BENCH_DURABLE_APPLY=native needs the native plog")
+        native_apply, sm_kind = False, "kv"     # toolchain-less host
+    if native_apply:
+        from raftsql_tpu.models.kv_native import NativeKV
+        kv_native = NativeKV(groups, node._plog_lib)
+        node.native_kv = kv_native
     try:
         for t in range(40 * cfg.election_ticks):
             node.tick()
@@ -1009,12 +1034,17 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                 nonlocal applied
                 applied += drain(node, apply=True)
 
+            base_applied = kv_native.total_applied if kv_native else 0
             node.overlap_hook = hook
             t0 = time.perf_counter()
             for _ in range(ticks):
                 node.tick()
             node.overlap_hook = None
             committed = applied + drain(node, apply=True)
+            if kv_native is not None:
+                # The C plane applied inside _publish; the queue drain
+                # above only flushed stragglers (normally zero).
+                committed += kv_native.total_applied - base_applied
             dt = time.perf_counter() - t0
             rate = committed / dt
             _log(f"  {committed} fused durable commits in {dt:.3f}s -> "
@@ -1032,8 +1062,26 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         lats: list = []
         for _ in range(8):
             node.tick()
-            if drain(node, apply=True) == 0:
+            if drain(node, apply=True) == 0 and kv_native is None:
                 break
+            # native mode: run the full 8 flush ticks (the queue is
+            # always empty; prev_ap below absorbs the pipeline tail).
+
+        if kv_native is not None:
+            # The C plane applies inside _publish: ack by watching each
+            # active group's applied index advance.
+            prev_ap = [kv_native.applied_index(g)
+                       for g in range(lat_active)]
+
+        def settle_native():
+            now2 = time.perf_counter()
+            for g in range(lat_active):
+                a = kv_native.applied_index(g)
+                fifo = t0q[g]
+                for _ in range(min(a - prev_ap[g], len(fifo))):
+                    lats.append(now2 - fifo.popleft())
+                prev_ap[g] = a
+
         for t in range(lat_ticks):
             now = time.perf_counter()
             cmds = ([mk_cmd] * E if mk_cmd is not None else
@@ -1042,10 +1090,16 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                 node.propose_many(g, cmds)
                 t0q[g].extend([now] * E)
             node.tick()
-            drain(node, apply=True, t0q=t0q, lats=lats)
+            if kv_native is not None:
+                settle_native()
+            else:
+                drain(node, apply=True, t0q=t0q, lats=lats)
         for _ in range(6):
             node.tick()
-            drain(node, apply=True, t0q=t0q, lats=lats)
+            if kv_native is not None:
+                settle_native()
+            else:
+                drain(node, apply=True, t0q=t0q, lats=lats)
         censored = sum(len(q) for q in t0q)
         lat_stats = None
         if lats:
@@ -1479,10 +1533,11 @@ def main() -> None:
             "", min(timeout_s, remaining() - fallback_reserve),
             extra_env={"BENCH_CONFIG": "durable",
                        "BENCH_DURABLE_MODE": "fused",
-                       # Measured best host shape (bench_logs r5): E=32
-                       # amortizes the per-group tick Python ~1.7x over
-                       # E=8 at identical durability.
-                       "BENCH_E": os.environ.get("BENCH_E", "32")},
+                       # Measured best host shape (bench_logs r5 with
+                       # the C++ apply plane): E=64 beats 32 (768k vs
+                       # 525k commits/s) and 128 (590k — WAL bytes
+                       # dominate past the framing amortization).
+                       "BENCH_E": os.environ.get("BENCH_E", "64")},
             label="durable-tpu-fused")
 
     # -- 3. durable-path children (host runtime measured on cpu):
@@ -1558,7 +1613,7 @@ def main() -> None:
             "cpu", min(timeout_s, remaining() - fallback_reserve),
             extra_env={"BENCH_CONFIG": "durable",
                        "BENCH_DURABLE_MODE": "fused",
-                       "BENCH_E": os.environ.get("BENCH_E", "32")},
+                       "BENCH_E": os.environ.get("BENCH_E", "64")},
             label="durable-cpu-fused")
 
     # -- 3b. latency child on the device: ONE small shape (G=1024, E=16)

@@ -131,6 +131,23 @@ def load_native_plog():
             c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint32), c.POINTER(c.c_int64),
             c.POINTER(c.c_uint64)]
+        lib.kv_new.restype = c.c_void_p
+        lib.kv_new.argtypes = [c.c_uint32]
+        lib.kv_free.restype = None
+        lib.kv_free.argtypes = [c.c_void_p]
+        lib.kv_apply_plog.restype = c.c_uint64
+        lib.kv_apply_plog.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_uint32,
+            c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint32), c.POINTER(c.c_uint64)]
+        lib.kv_applied.restype = c.c_uint64
+        lib.kv_applied.argtypes = [c.c_void_p, c.c_uint32]
+        lib.kv_count.restype = c.c_uint64
+        lib.kv_count.argtypes = [c.c_void_p, c.c_uint32]
+        lib.kv_get.restype = c.c_int64
+        lib.kv_get.argtypes = [
+            c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint32,
+            c.POINTER(c.c_uint8), c.c_uint32]
     except AttributeError as e:     # pragma: no cover - stale build
         log.warning("native plog ABI missing (%s); Python fallback", e)
         return None

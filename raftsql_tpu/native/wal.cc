@@ -14,6 +14,8 @@
 //     type 2 HARDSTATE: u32 group | u64 term  | i64 vote | u64 commit
 //     type 3 SNAPSHOT:  u32 group | u64 index | u64 term
 //     type 4 COMPACT:   u32 group | u64 index | u64 term
+//     type 5 RANGE:     u32 group | u64 start | u64 term | u32 count
+//                       | u32 lens[count] | payload bytes
 //
 // Build: g++ -O2 -shared -fPIC -o _native_wal.so wal.cc
 // ABI: plain C, consumed via ctypes (no pybind11 in this environment).
@@ -22,6 +24,8 @@
 #include <cstring>
 #include <fcntl.h>
 #include <mutex>
+#include <string>
+#include <unordered_map>
 #include <unistd.h>
 #include <vector>
 
@@ -39,10 +43,13 @@ struct CrcInit {
   }
 } crc_init;
 
-uint32_t crc32z(const uint8_t* p, size_t n) {  // zlib-compatible
-  uint32_t c = 0xFFFFFFFFu;
+uint32_t crc32z_update(uint32_t c, const uint8_t* p, size_t n) {
   for (size_t i = 0; i < n; ++i) c = kCrcTable[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
+  return c;
+}
+
+uint32_t crc32z(const uint8_t* p, size_t n) {  // zlib-compatible
+  return crc32z_update(0xFFFFFFFFu, p, n) ^ 0xFFFFFFFFu;
 }
 
 struct Wal {
@@ -342,6 +349,57 @@ void wal_entry_locked(Wal* w, std::vector<uint8_t>& body, uint32_t g,
   frame(w, body);
 }
 
+// One type-5 RANGE record (same layout as wal_append_ranges): entries
+// at start..start+n-1, all with `term`, lens/payloads concatenated.
+void wal_range_locked(Wal* w, std::vector<uint8_t>& body, uint32_t g,
+                      uint64_t start, uint64_t term, uint32_t n,
+                      const uint32_t* lens, const uint8_t* blob,
+                      size_t bytes) {
+  body.clear();
+  body.reserve(25 + 4 * size_t(n) + bytes);
+  body.push_back(5);
+  put_u32(body, g);
+  put_u64(body, start);
+  put_u64(body, term);
+  put_u32(body, n);
+  for (uint32_t i = 0; i < n; ++i) put_u32(body, lens[i]);
+  if (bytes) body.insert(body.end(), blob, blob + bytes);
+  frame(w, body);
+}
+
+// Gather-framed RANGE: one type-5 record for entries [k0, k1) of
+// `datas` (all term `term`), framed DIRECTLY into w->buf — the CRC is
+// computed incrementally over head + payloads, so the payload bytes
+// are copied exactly once.  Byte-identical to wal_range_locked; used
+// by the mirror path, which re-copies every committed byte to P-1
+// peers per tick and is memcpy-bound.
+void wal_range_gather_locked(Wal* w, std::vector<uint8_t>& head,
+                             uint32_t g, uint64_t start, uint64_t term,
+                             const std::string* datas, uint32_t k0,
+                             uint32_t k1) {
+  head.clear();
+  head.push_back(5);
+  put_u32(head, g);
+  put_u64(head, start);
+  put_u64(head, term);
+  put_u32(head, k1 - k0);
+  size_t bytes = 0;
+  for (uint32_t k = k0; k < k1; ++k) {
+    put_u32(head, uint32_t(datas[k].size()));
+    bytes += datas[k].size();
+  }
+  uint32_t c = crc32z_update(0xFFFFFFFFu, head.data(), head.size());
+  for (uint32_t k = k0; k < k1; ++k)
+    c = crc32z_update(
+        c, reinterpret_cast<const uint8_t*>(datas[k].data()),
+        datas[k].size());
+  put_u32(w->buf, c ^ 0xFFFFFFFFu);
+  put_u32(w->buf, uint32_t(head.size() + bytes));
+  w->buf.insert(w->buf.end(), head.begin(), head.end());
+  for (uint32_t k = k0; k < k1; ++k)
+    w->buf.insert(w->buf.end(), datas[k].begin(), datas[k].end());
+}
+
 }  // namespace
 
 extern "C" {
@@ -509,13 +567,12 @@ int walplog_put_uniform(void* wal_h, void* plog_h, uint32_t n_ranges,
   std::vector<uint8_t> body;
   for (uint32_t r = 0; r < n_ranges; ++r) {
     uint32_t n = counts[r];
+    if (n == 0) continue;               // empty runs write nothing
     tbuf.assign(n, terms[r]);
     size_t range_bytes = 0;
-    for (uint32_t i = 0; i < n; ++i) {
-      wal_entry_locked(w, body, groups[r], starts[r] + i, terms[r],
-                       blob + off + range_bytes, lens[li + i]);
-      range_bytes += lens[li + i];
-    }
+    for (uint32_t i = 0; i < n; ++i) range_bytes += lens[li + i];
+    wal_range_locked(w, body, groups[r], starts[r], terms[r], n,
+                     lens + li, blob + off, range_bytes);
     int rc = plog_put_locked(p->groups[groups[r]], starts[r], n,
                              tbuf.data(), blob + off, lens + li, -1);
     if (rc != 0) return rc;
@@ -564,12 +621,19 @@ int walplog_mirror_all(void** wals, void** plogs, uint32_t n_mirrors,
     int64_t rel = int64_t(starts[i]) - 1 - int64_t(pg.start);
     std::vector<uint8_t> body;
     size_t buf0 = w->buf.size();
+    // WAL records as same-term RANGE runs (split at term boundaries —
+    // rare: only elections change terms inside a mirrored batch),
+    // gather-framed so each payload byte is copied once.
+    for (uint32_t k0 = 0; k0 < n;) {
+      uint64_t t = scratch[i].terms[k0];
+      uint32_t k1 = k0;
+      while (k1 < n && scratch[i].terms[k1] == t) ++k1;
+      wal_range_gather_locked(w, body, groups[i], starts[i] + k0, t,
+                              scratch[i].datas.data(), k0, k1);
+      k0 = k1;
+    }
     for (uint32_t k = 0; k < n; ++k) {
       const std::string& d = scratch[i].datas[k];
-      wal_entry_locked(w, body, groups[i], starts[i] + k,
-                       scratch[i].terms[k],
-                       reinterpret_cast<const uint8_t*>(d.data()),
-                       uint32_t(d.size()));
       int64_t pos = rel + int64_t(k);
       if (pos < 0) continue;
       if (pos < int64_t(pg.datas.size())) {
@@ -596,6 +660,120 @@ int walplog_mirror_all(void** wals, void** plogs, uint32_t n_mirrors,
     }
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Native KV apply plane: the C++ counterpart of models/kv_sm.py, fed
+// RANGES straight from the native payload log — committed entries are
+// parsed and applied without ever materializing Python objects (the
+// measured ceiling of the Python-resident durable path was per-entry
+// object handling).  Command grammar matches KVStateMachine.apply:
+//   "SET <key> <value>"  (value may contain spaces)
+//   "DEL <key>"          (exactly one token after DEL)
+// anything else counts as a bad command (reported, not fatal), and an
+// entry at or below the group's applied index is skipped (exactly-once
+// across replays/installs, KVStateMachine.apply's index guard).
+
+struct Kv {
+  std::vector<std::unordered_map<std::string, std::string>> groups;
+  std::vector<uint64_t> applied;
+  std::mutex mu;
+};
+
+void* kv_new(uint32_t num_groups) {
+  Kv* kv = new Kv();
+  kv->groups.resize(num_groups);
+  kv->applied.assign(num_groups, 0);
+  return kv;
+}
+
+void kv_free(void* h) { delete static_cast<Kv*>(h); }
+
+// Apply plog entries [starts[r], starts[r]+counts[r]) of groups[r] for
+// every range; empty payloads (no-op entries) skipped.  Returns the
+// number applied, or UINT64_MAX when a committed index falls outside
+// the payload-log window (the wrapper raises, matching the Python
+// path's "payload log shorter than commit" RuntimeError) — work done
+// before the fault IS recorded in applied[], so nothing double-applies
+// on retry.  Bad commands are counted into *bad (may be null).
+// Holds both locks for the batch: the caller (the fused runtime's
+// publish, or its overlap window) owns the tick thread, so there is no
+// producer to stall.
+uint64_t kv_apply_plog(void* kv_h, void* plog_h, uint32_t n_ranges,
+                       const uint32_t* groups, const uint64_t* starts,
+                       const uint32_t* counts, uint64_t* bad) {
+  Kv* kv = static_cast<Kv*>(kv_h);
+  Plog* p = static_cast<Plog*>(plog_h);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  std::lock_guard<std::mutex> lp(p->mu);
+  uint64_t done = 0, nbad = 0;
+  for (uint32_t r = 0; r < n_ranges; ++r) {
+    uint32_t g = groups[r];
+    PlogGroup& pg = p->groups[g];
+    auto& map = kv->groups[g];
+    uint64_t ap = kv->applied[g];
+    for (uint32_t i = 0; i < counts[r]; ++i) {
+      uint64_t idx = starts[r] + i;
+      if (idx <= ap) continue;
+      int64_t rel = int64_t(idx) - 1 - int64_t(pg.start);
+      if (rel < 0 || size_t(rel) >= pg.datas.size()) {
+        kv->applied[g] = ap;
+        if (bad) *bad += nbad;
+        return UINT64_MAX;
+      }
+      const std::string& d = pg.datas[size_t(rel)];
+      ap = idx;
+      if (d.empty()) continue;                   // no-op entry
+      if (d.size() > 4 && !d.compare(0, 4, "SET ")) {
+        size_t sp = d.find(' ', 4);
+        if (sp != std::string::npos && sp + 1 <= d.size()) {
+          map[d.substr(4, sp - 4)] = d.substr(sp + 1);
+          ++done;
+          continue;
+        }
+      } else if (d.size() >= 4 && !d.compare(0, 4, "DEL ")) {
+        // "DEL <key>" with exactly one token after DEL; an empty key
+        // is valid (split(" ", 2) parity with KVStateMachine.apply).
+        if (d.find(' ', 4) == std::string::npos) {
+          map.erase(d.substr(4));
+          ++done;
+          continue;
+        }
+      }
+      ++nbad;
+    }
+    kv->applied[g] = ap;
+  }
+  if (bad) *bad += nbad;
+  return done;
+}
+
+uint64_t kv_applied(void* h, uint32_t g) {
+  Kv* kv = static_cast<Kv*>(h);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  return kv->applied[g];
+}
+
+uint64_t kv_count(void* h, uint32_t g) {
+  Kv* kv = static_cast<Kv*>(h);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  return kv->groups[g].size();
+}
+
+// Value of `key` into out (cap bytes); returns the value length, or -1
+// if absent.  A return > cap means the buffer was too small (caller
+// retries with a bigger one).
+int64_t kv_get(void* h, uint32_t g, const uint8_t* key, uint32_t klen,
+               uint8_t* out, uint32_t cap) {
+  Kv* kv = static_cast<Kv*>(h);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  auto& map = kv->groups[g];
+  auto it = map.find(std::string(reinterpret_cast<const char*>(key),
+                                 klen));
+  if (it == map.end()) return -1;
+  const std::string& v = it->second;
+  if (v.size() <= cap && cap) memcpy(out, v.data(), v.size());
+  return int64_t(v.size());
 }
 
 }  // extern "C"

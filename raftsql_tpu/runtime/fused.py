@@ -128,11 +128,22 @@ class FusedClusterNode:
         # must only consume the commit queues (anything else races the
         # tick).
         self.overlap_hook = None
+        # Which peers' commit queues receive live publishes (None =
+        # all).  Deployments that consume a single peer's stream (the
+        # --fused server and the durable bench drain peer 0) set {0}
+        # and skip 2/3 of the publish slicing + queue traffic.
+        self.publish_peers: Optional[set] = None
+        # Native KV apply plane (models/kv_native.py): when set AND the
+        # payload plane is native, peer 0's committed ranges are applied
+        # inside one C call per publish instead of being materialized as
+        # Python bytes for a queue consumer.
+        self.native_kv = None
         self.error: Optional[Exception] = None
         self._work_evt = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_active = True
+        self._spin_hot = True
         # One worker per peer for the end-of-tick durable barrier: the
         # P per-peer fsyncs overlap (independent files; fsync releases
         # the GIL), so the barrier costs max not sum of the fsyncs.
@@ -259,9 +270,18 @@ class FusedClusterNode:
                 # pause consensus outright — every peer pauses with it,
                 # so no election can fire spuriously and nothing is
                 # missed; the next proposal (work event) resumes it.
-                # The 0.5 s cap is a safety heartbeat.
-                self._work_evt.wait(
-                    interval_s if self._tick_active else 0.5)
+                # The 0.5 s cap is a safety heartbeat.  While HOT
+                # (client work in flight), loop back-to-back: the
+                # tick's own wall time is the pacing, and relative
+                # timer safety (heartbeat period < election timeout)
+                # holds at any wall rate because all peers step
+                # together — each saved interval_s is a propose→commit
+                # pipeline hop clients don't wait.  ACTIVE-but-not-hot
+                # (e.g. leaderless warmup) paces at interval_s.
+                if not self._tick_active:
+                    self._work_evt.wait(0.5)
+                elif not self._spin_hot:
+                    self._work_evt.wait(interval_s)
 
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name="fused-cluster")
@@ -551,6 +571,13 @@ class FusedClusterNode:
                        or dev_busy
                        or bool((self._hints < 0).any())
                        or bool(self._queued))
+        # HOT means real client work is flowing (writes this tick, a
+        # device dispatch still in flight, or a proposal backlog): the
+        # threaded loop then ticks back-to-back.  Merely-leaderless
+        # groups keep the loop ACTIVE (elections must advance) but not
+        # hot — warmup paces at interval_s instead of starving the
+        # host core the cluster shares with its clients.
+        self._spin_hot = tick_active or dev_busy or bool(self._queued)
         if base_active:
             self._pending_pinfo = pinfo      # next tick overlaps it
         else:
@@ -579,10 +606,28 @@ class FusedClusterNode:
             ready = np.nonzero(commit > self._applied[p])[0]
             if not ready.size:
                 continue
+            if self.publish_peers is not None \
+                    and p not in self.publish_peers:
+                # Nobody consumes this peer's stream: advance the
+                # cursor without materializing anything.
+                if p == 0:
+                    self.metrics.commits += int(
+                        (commit[ready] - self._applied[p][ready]).sum())
+                self._applied[p][ready] = commit[ready]
+                continue
             plog = self.plogs[p]
             gl = ready.tolist()
             cl = commit[ready].tolist()
             al = self._applied[p][ready].tolist()
+            if p == 0 and self.native_kv is not None:
+                # C-resident apply: one call, zero Python per entry.
+                self.native_kv.apply_plog(
+                    plog.handle, gl, [a + 1 for a in al],
+                    [c - a for c, a in zip(cl, al)])
+                self._applied[p][ready] = commit[ready]
+                self.metrics.commits += int(
+                    (commit[ready] - np.asarray(al)).sum())
+                continue
             items = []
             if hasattr(plog, "read_groups"):
                 # Native plog: every ready range in TWO ctypes calls.
@@ -691,6 +736,9 @@ class FusedPipe:
 
     def __init__(self, node: FusedClusterNode):
         self.node = node
+        # This facade is the only consumer and it reads peer 0's
+        # stream; skip materializing the other peers' publishes.
+        node.publish_peers = {0}
         self.commit_q = node.commit_q(0)
 
     def propose(self, group: int, payload: bytes) -> None:

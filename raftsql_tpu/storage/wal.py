@@ -407,7 +407,7 @@ class WAL:
     def append_ranges_uniform(self, plog, groups, starts, counts, terms,
                               blob: bytes, lens) -> bool:
         """Combined native write (walplog_put_uniform): for each range
-        (group, start, count, term) write the WAL ENTRY records AND the
+        (group, start, count, term) write ONE WAL RANGE record AND the
         native payload-log range, all in one C call — zero per-entry
         Python.  `blob` concatenates every range's payload bytes in
         order; `lens` is per-entry.  Returns False when the native
@@ -438,11 +438,17 @@ class WAL:
         if rc != 0:
             raise ValueError("walplog_put_uniform: payload gap")
         bump = self._active_stats.bump
+        live = 0
         for g, s, c in zip(ga.tolist(), sa.tolist(), ca.tolist()):
-            bump(g, s + c - 1)
+            if c:             # native side skips empty runs entirely
+                bump(g, s + c - 1)
+                live += 1
         self._pending = True
-        self._bytes += int(ca.sum()) * (_HDR.size + _ENTRY.size) \
-            + len(blob)
+        # One RANGE record per non-empty run (native writes type-5 —
+        # keep _bytes matched to the file so rotation fires where
+        # segment_bytes intends).
+        self._bytes += live * (_HDR.size + _RANGE.size) \
+            + 4 * int(ca.sum()) + len(blob)
         return True
 
     def set_hardstate(self, group: int, term: int, vote: int,

@@ -1,0 +1,18 @@
+#!/bin/sh
+# One-shot tunnel health probe: appends a status line to
+# bench_logs/r5_tunnel_probes.log (timestamp + ok/wedged + latency).
+cd /root/repo || exit 1
+t0=$(date -u +%s)
+out=$(timeout 75 python -c "
+import time
+t0 = time.time()
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+y = float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+print('ok', d[0].platform, round(time.time() - t0, 1))" 2>/dev/null | tail -1)
+t1=$(date -u +%s)
+if [ -z "$out" ]; then
+    out="wedged timeout=$((t1 - t0))s"
+fi
+echo "$(date -u +%FT%TZ) $out" >> bench_logs/r5_tunnel_probes.log
