@@ -17,6 +17,16 @@ VPU; a masked sum over W lanes fuses).
 Blocks stream G in `block_g`-row tiles through VMEM; all shapes static.
 On non-TPU backends the kernel runs in interpreter mode (slow, but keeps
 tests hermetic on the CPU CI platform).
+
+MEASURED VERDICT (round-5 rules race, live chip — bench_logs/
+r5_tpu_head_e932a09.log): `point` beats this kernel at every benched
+shape — 287.8M vs 78.8M commits/s at G=10k/P=3, and at its claimed
+large-P regime (G=2k/P=15) point did 45.1M while THIS KERNEL'S COMPILE
+HUNG past the bench timeout.  The sort XLA emits for the point rule
+fuses into the surrounding step; this kernel's VMEM streaming does not.
+`commit_rule="point"` stays the default at every P; the kernel is kept
+as a tested reference implementation of the comparison-network idea and
+as the repo's pallas exemplar, not as a fast path.
 """
 from __future__ import annotations
 
