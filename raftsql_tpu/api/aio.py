@@ -1,0 +1,330 @@
+"""Single-threaded asyncio HTTP plane — same client API as api/http.py.
+
+The reference serves its HTTP API with Go's net/http (one goroutine per
+connection, reference httpapi.go:26-79).  The stdlib-threaded port of
+that shape (api/http.py) spends most of its request budget on thread
+machinery once clients are concurrent: one OS thread per connection
+contending for the GIL with the consensus tick thread, plus one
+Event.wait/set round trip per acknowledged proposal.
+
+This plane is the event-loop redesign: ONE thread runs a minimal
+HTTP/1.1 state machine for every connection, proposals go straight to
+`RaftDB.propose`, and commit acknowledgements ride a BATCHED bridge —
+the consensus consumer resolves AckFutures from its own thread, the
+bridge coalesces every ack that lands between two loop iterations into
+a single `call_soon_threadsafe` wakeup (one loop wakeup per commit
+batch, not per request).  Reads (which may block on SQLite or a
+ReadIndex round) run in a small executor so the loop never stalls.
+
+Semantics parity with api/http.py, pinned by the parametrized fixture
+in tests/test_api_http.py (every test runs against both planes):
+PUT 204/400 + blocking-until-applied contract (reference
+httpapi.go:38-49), GET local reads + X-Consistency: linear (421 +
+X-Raft-Leader elsewhere, 503 on timeout), GET /metrics, 405 with Allow
+on anything else (connection stays usable), X-Raft-Group routing.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Optional
+
+from raftsql_tpu.runtime.db import NotLeaderError, RaftDB
+
+log = logging.getLogger("raftsql.api.aio")
+
+_MAX_HEAD = 64 * 1024          # header block cap before we drop the conn
+_MAX_BODY = 4 * 1024 * 1024    # SQL statement cap (parity: unbounded-ish)
+_ALLOW = (b"HTTP/1.1 405 Method Not Allowed\r\nAllow: PUT, GET\r\n"
+          b"Content-Length: 19\r\n\r\nMethod not allowed\n")
+_ALLOW_NOBODY = (b"HTTP/1.1 405 Method Not Allowed\r\nAllow: PUT, GET\r\n"
+                 b"Content-Length: 0\r\n\r\n")
+_204 = b"HTTP/1.1 204 No Content\r\n\r\n"
+
+
+def _resp(code: int, reason: bytes, body: bytes = b"",
+          ctype: bytes = b"text/plain; charset=utf-8",
+          extra: tuple = ()) -> bytes:
+    head = [b"HTTP/1.1 " + str(code).encode() + b" " + reason]
+    for k, v in extra:
+        head.append(k + b": " + v)
+    head.append(b"Content-Type: " + ctype)
+    head.append(b"Content-Length: " + str(len(body)).encode())
+    head.append(b"")
+    return b"\r\n".join(head) + b"\r\n" + body
+
+
+class _AckBridge:
+    """Batch cross-thread ack delivery into the event loop.
+
+    AckFuture callbacks fire on the commit-consumer thread, one per
+    request; waking the loop per request would re-create the per-ack
+    syscall the redesign removes.  Every ack landing while a flush is
+    pending is appended under the lock and delivered by the SAME
+    scheduled flush — one loop wakeup per commit batch under load."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+        self._mu = threading.Lock()
+        self._pending: list = []
+        self._scheduled = False
+
+    def deliver(self, afut: asyncio.Future, err) -> None:
+        with self._mu:
+            self._pending.append((afut, err))
+            if self._scheduled:
+                return
+            self._scheduled = True
+        try:
+            self.loop.call_soon_threadsafe(self._flush)
+        except RuntimeError:     # loop closed during shutdown
+            with self._mu:       # un-mute: a live loop must reschedule
+                self._scheduled = False
+
+    def _flush(self) -> None:
+        with self._mu:
+            items, self._pending = self._pending, []
+            self._scheduled = False
+        for afut, err in items:
+            if not afut.done():
+                afut.set_result(err)
+
+
+class _Conn(asyncio.Protocol):
+    """One HTTP/1.1 keep-alive connection: sequential request/response
+    (pipelined bytes buffer and are parsed as soon as the in-flight
+    response is written)."""
+
+    def __init__(self, srv: "AioSQLServer"):
+        self.srv = srv
+        self.buf = bytearray()
+        self.busy = False      # a request handler owns the connection
+        self.closed = False
+
+    # -- transport events ----------------------------------------------
+
+    def connection_made(self, transport) -> None:
+        self.tr = transport
+        try:
+            import socket
+            sock = transport.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:          # pragma: no cover - platform quirk
+            pass
+
+    def connection_lost(self, exc) -> None:
+        self.closed = True
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        if not self.busy:
+            self._pump()
+
+    # -- request framing -----------------------------------------------
+
+    def _pump(self) -> None:
+        while not self.busy and not self.closed:
+            req = self._parse_one()
+            if req is None:
+                return
+            method, path, headers, body = req
+            if method == b"PUT":
+                self.busy = True
+                self.srv.loop.create_task(self._do_put(headers, body))
+            elif method == b"GET":
+                if path == b"/metrics":
+                    payload = self.srv.rdb.render_metrics().encode()
+                    self.tr.write(_resp(200, b"OK", payload,
+                                        b"application/json"))
+                    continue
+                self.busy = True
+                self.srv.loop.create_task(self._do_get(headers, body))
+            elif method == b"HEAD":
+                self.tr.write(_ALLOW_NOBODY)
+            else:
+                self.tr.write(_ALLOW)
+
+    def _parse_one(self):
+        """One complete request from self.buf, or None if incomplete.
+        Malformed framing answers 400 and drops the connection (the
+        stream position is unrecoverable)."""
+        buf = self.buf
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            if len(buf) > _MAX_HEAD:
+                self._fail(b"header block too large\n")
+            return None
+        try:
+            head = bytes(buf[:end]).split(b"\r\n")
+            method, path, _version = head[0].split(b" ", 2)
+            clen = 0
+            group = b"0"
+            linear = False
+            for line in head[1:]:
+                k, _, v = line.partition(b":")
+                k = k.strip().lower()
+                if k == b"content-length":
+                    clen = int(v.strip())
+                elif k == b"x-raft-group":
+                    group = v.strip()
+                elif k == b"x-consistency":
+                    linear = v.strip().lower() == b"linear"
+        except (ValueError, IndexError):
+            self._fail(b"malformed request\n")
+            return None
+        if not 0 <= clen <= _MAX_BODY:
+            self._fail(b"bad content-length\n")
+            return None
+        total = end + 4 + clen
+        if len(buf) < total:
+            return None
+        body = bytes(buf[end + 4:total])
+        del buf[:total]
+        return method, path, {"group": group, "linear": linear}, body
+
+    def _fail(self, msg: bytes) -> None:
+        self.tr.write(_resp(400, b"Bad Request", msg))
+        self.tr.close()
+        self.closed = True
+
+    # -- handlers (one in flight per connection) -----------------------
+
+    def _finish(self, payload: bytes) -> None:
+        if not self.closed:
+            self.tr.write(payload)
+        self.busy = False
+        if self.buf and not self.closed:
+            self._pump()
+
+    async def _do_put(self, headers: dict, body: bytes) -> None:
+        rdb = self.srv.rdb
+        try:
+            query = body.decode("utf-8")
+            group = int(headers["group"] or 0)
+        except ValueError as e:
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        fut = rdb.propose(query, group)
+        afut = self.srv.loop.create_future()
+        fut.add_done_callback(
+            lambda err: self.srv.bridge.deliver(afut, err))
+        try:
+            err = await asyncio.wait_for(afut, self.srv.timeout_s)
+        except asyncio.TimeoutError:
+            # Deregister the ack so it cannot leak; the statement may
+            # still commit later (api/http.py's abandon contract).
+            rdb.abandon(query, group, fut)
+            self._finish(_resp(
+                400, b"Bad Request", b"proposal not committed in time\n"))
+            return
+        if err is not None:
+            log.info("client error: %s", err)
+            self._finish(_resp(400, b"Bad Request",
+                               (str(err) + "\n").encode()))
+        else:
+            self._finish(_204)
+
+    async def _do_get(self, headers: dict, body: bytes) -> None:
+        rdb = self.srv.rdb
+        try:
+            query = body.decode("utf-8")
+            group = int(headers["group"] or 0)
+        except ValueError as e:
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        try:
+            # Reads block (SQLite, and linear reads wait out a quorum
+            # round + apply) — keep them off the loop thread.
+            rows = await self.srv.loop.run_in_executor(
+                self.srv._read_pool, lambda: rdb.query(
+                    query, group, linear=headers["linear"],
+                    timeout=self.srv.timeout_s))
+        except NotLeaderError as e:
+            extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
+                if e.leader > 0 else ()
+            self._finish(_resp(421, b"Misdirected Request",
+                               (str(e) + "\n").encode(), extra=extra))
+            return
+        except TimeoutError as e:
+            self._finish(_resp(503, b"Service Unavailable",
+                               (str(e) + "\n").encode()))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        self._finish(_resp(200, b"OK", rows.encode("utf-8")))
+
+
+class AioSQLServer:
+    """Drop-in alternative to api/http.py's SQLServer: same constructor
+    shape, same start()/stop() lifecycle, one event-loop thread."""
+
+    def __init__(self, port: int, rdb: RaftDB, host: str = "",
+                 timeout_s: float = 30.0):
+        self.port = port
+        self.rdb = rdb
+        self.host = host
+        self.timeout_s = timeout_s
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.bridge: Optional[_AckBridge] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._start_err: Optional[BaseException] = None
+        self._server = None
+        from concurrent.futures import ThreadPoolExecutor
+        self._read_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="aio-read")
+
+    async def _serve(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        self.bridge = _AckBridge(self.loop)
+        self._server = await self.loop.create_server(
+            lambda: _Conn(self), self.host or None, self.port,
+            backlog=256, reuse_address=True)
+        if self.port == 0:      # tests bind port 0 and read it back
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def serve_forever(self) -> None:
+        try:
+            asyncio.run(self._serve())
+        except asyncio.CancelledError:
+            pass
+        except BaseException as e:    # surface bind errors to start()
+            self._start_err = e
+            self._started.set()
+            if threading.current_thread() is not self._thread:
+                raise               # direct serve_forever() callers
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="aio-http")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("aio http server failed to start")
+        if self._start_err is not None:
+            # The threaded SQLServer raises e.g. EADDRINUSE from its
+            # constructor; re-raise the real cause here for parity.
+            raise self._start_err
+
+    def stop(self) -> None:
+        loop = self.loop
+        if loop is not None and loop.is_running():
+            def _shutdown():
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()
+            try:
+                loop.call_soon_threadsafe(_shutdown)
+            except RuntimeError:  # pragma: no cover - already closed
+                pass
+        if self._thread is not None:
+            self._thread.join(5)
+        self._read_pool.shutdown(wait=False)

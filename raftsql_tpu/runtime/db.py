@@ -142,16 +142,34 @@ class AckFuture:
     def __init__(self):
         self._evt = threading.Event()
         self._err: Optional[Exception] = None
+        self._cb = None
+        self._cb_mu = threading.Lock()
         self.created = time.monotonic()
 
     def set(self, err: Optional[Exception]) -> None:
         self._err = err
         self._evt.set()
+        with self._cb_mu:
+            cb, self._cb = self._cb, None
+        if cb is not None:
+            cb(err)
 
     def wait(self, timeout: Optional[float] = None) -> Optional[Exception]:
         if not self._evt.wait(timeout):
             raise TimeoutError("proposal not committed in time")
         return self._err
+
+    def add_done_callback(self, cb) -> None:
+        """Deliver the result to `cb(err)` instead of (or in addition
+        to) a blocking wait() — the async API plane's bridge.  At most
+        one callback; runs on the resolver's thread (the commit
+        consumer), or immediately here if already resolved.  Called
+        exactly once."""
+        with self._cb_mu:
+            if not self._evt.is_set():
+                self._cb = cb
+                return
+        cb(self._err)
 
 
 class RaftDB:

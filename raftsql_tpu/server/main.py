@@ -148,6 +148,11 @@ def main(argv=None) -> None:
                          "step per tick (no --cluster/--id needed)")
     ap.add_argument("--peers", type=int, default=3,
                     help="with --fused: peers per group")
+    ap.add_argument("--http-engine", choices=("aio", "threaded"),
+                    default="aio",
+                    help="HTTP plane: single-thread event loop with "
+                         "batched commit acks (aio, default) or the "
+                         "thread-per-connection stdlib port (threaded)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -179,7 +184,11 @@ def main(argv=None) -> None:
                          compact_every=args.compact_every,
                          compact_keep=args.compact_keep,
                          wal_segment_bytes=args.wal_segment_bytes)
-    serve_http_sql_api(args.port, rdb)
+    if args.http_engine == "aio":
+        from raftsql_tpu.api.aio import AioSQLServer
+        AioSQLServer(args.port, rdb).serve_forever()
+    else:
+        serve_http_sql_api(args.port, rdb)
 
 
 if __name__ == "__main__":

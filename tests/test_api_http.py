@@ -1,10 +1,14 @@
 """HTTP API tests: reference semantics (PUT/GET/405, httpapi.go:36-66)
-plus the multi-group and robustness extensions."""
+plus the multi-group and robustness extensions.  Every test runs
+against BOTH serving planes — the threaded stdlib port (api/http.py)
+and the event-loop redesign (api/aio.py) — the parametrized fixture is
+the parity contract between them."""
 import http.client
 
 import pytest
 
 from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.api.aio import AioSQLServer
 from raftsql_tpu.api.http import SQLServer
 from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
 from raftsql_tpu.runtime.db import RaftDB
@@ -14,8 +18,8 @@ from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
 TIMEOUT = 30.0
 
 
-@pytest.fixture
-def server(tmp_path):
+@pytest.fixture(params=["threaded", "aio"])
+def server(request, tmp_path):
     """Single-node cluster (self-elects) behind a real HTTP server."""
     cfg = RaftConfig(num_groups=2, num_peers=1, tick_interval_s=0.005,
                      log_window=64, max_entries_per_msg=4)
@@ -23,7 +27,8 @@ def server(tmp_path):
                            data_dir=str(tmp_path / "raftsql-1"))
     rdb = RaftDB(lambda g: SQLiteStateMachine(
         str(tmp_path / f"api-g{g}.db")), pipe, num_groups=2)
-    srv = SQLServer(0, rdb, host="127.0.0.1", timeout_s=TIMEOUT)
+    srv_cls = SQLServer if request.param == "threaded" else AioSQLServer
+    srv = srv_cls(0, rdb, host="127.0.0.1", timeout_s=TIMEOUT)
     srv.start()
     yield srv
     srv.stop()
