@@ -809,6 +809,49 @@ def bench_http(groups: int, seconds: float, clients: int,
         _log(f"  cluster of {n_procs} ready ({groups} groups) on api "
              f"ports {api_ports}")
 
+        # Load plane: the C++ epoll generator when the toolchain is up
+        # (BENCH_HTTP_LOADGEN=python forces the thread-per-client
+        # fallback).  The Python clients cost ~120-250us of interpreter
+        # time per request ON THE SERVER'S CORES — at 192 clients they
+        # are half the measured ceiling (3.9k vs 7.1k req/s, fused).
+        loadgen = None
+        if os.environ.get("BENCH_HTTP_LOADGEN", "native") == "native":
+            from raftsql_tpu.native.build import build_http_load
+            loadgen = build_http_load()
+        if loadgen is not None:
+            out = sp.run(
+                [loadgen, str(seconds), str(clients), str(groups)]
+                + [str(p) for p in api_ports],
+                capture_output=True, text=True, timeout=seconds + 60)
+            if out.returncode != 0:
+                raise RuntimeError(f"http_load rc={out.returncode}: "
+                                   f"{out.stderr[-400:]}")
+            j = json.loads(out.stdout.strip())
+            if not j["n"]:
+                raise RuntimeError(
+                    f"no successful PUTs ({j['errors']} errors)")
+            got = None
+            for p in api_ports:
+                c = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+                c.request("GET", "/", body=b"SELECT count(*) FROM t")
+                r = c.getresponse()
+                got = r.read().decode()
+                assert r.status == 200, (r.status, got)
+                c.close()
+            rate = j["n"] / j["secs"]
+            stats = {"p50_ms": j["p50_ms"], "p99_ms": j["p99_ms"],
+                     "n": j["n"], "errors": j["errors"],
+                     "clients": clients, "groups": groups,
+                     "replica_rows": got.strip(),
+                     "deploy": "fused-1proc" if fused else "3proc",
+                     "loadgen": "native",
+                     "req_per_s": round(rate, 1)}
+            _log(f"  {j['n']} HTTP PUTs (native loadgen) in "
+                 f"{j['secs']:.1f}s -> {rate:,.0f} req/s; "
+                 f"p50={j['p50_ms']} ms p99={j['p99_ms']} ms, "
+                 f"{j['errors']} errors")
+            return rate, {"http_lat": stats}
+
         stop_at = time.monotonic() + seconds
         lats: list = []
         errs = [0]

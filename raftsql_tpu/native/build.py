@@ -24,20 +24,31 @@ _lock = threading.Lock()
 _cache: dict = {}
 
 
-def _build(src: str, so: str) -> bool:
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src]
+def _compile(src: str, dest: str, link_args: tuple) -> bool:
+    """Compile `src` to `dest` atomically (tmp + rename, so concurrent
+    processes never open a half-written artifact); True on success,
+    warning + False on any failure, temp never leaked."""
+    fd, tmp = tempfile.mkstemp(dir=_DIR)
+    os.close(fd)
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        log.warning("native build unavailable (%s); using Python fallback",
-                    e)
-        return False
-    if proc.returncode != 0:
-        log.warning("native build failed; using Python fallback:\n%s",
-                    proc.stderr)
-        return False
-    return True
+        cmd = ["g++", "-O2", "-std=c++17", *link_args, "-o", tmp, src]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            log.warning("native build unavailable (%s); using Python "
+                        "fallback", e)
+            return False
+        if proc.returncode != 0:
+            log.warning("native build failed; using Python fallback:\n%s",
+                        proc.stderr)
+            return False
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, dest)
+        return True
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load(name: str):
@@ -53,14 +64,7 @@ def _load(name: str):
         try:
             if not os.path.isfile(so) or \
                     os.path.getmtime(so) < os.path.getmtime(src):
-                # Build in a temp file then rename, so concurrent
-                # processes never dlopen a half-written object.
-                fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so")
-                os.close(fd)
-                if _build(src, tmp):
-                    os.replace(tmp, so)
-                else:
-                    os.unlink(tmp)
+                if not _compile(src, so, ("-shared", "-fPIC")):
                     _cache[name] = None
                     return None
             lib = ctypes.CDLL(so)
@@ -70,6 +74,31 @@ def _load(name: str):
             lib = None
         _cache[name] = lib
         return lib
+
+
+def build_http_load():
+    """Compile native/http_load.cc into a standalone load-generator
+    binary (the bench harness's `wrk`); returns its path, or None when
+    the toolchain is unavailable (callers fall back to the Python
+    client threads)."""
+    if os.environ.get("RAFTSQL_TPU_NATIVE", "1") == "0":
+        return None
+    src = os.path.join(_DIR, "http_load.cc")
+    exe = os.path.join(_DIR, "_http_load")
+    with _lock:
+        if "http_load" in _cache:
+            return _cache["http_load"]
+        path = exe
+        try:
+            if not os.path.isfile(exe) or \
+                    os.path.getmtime(exe) < os.path.getmtime(src):
+                if not _compile(src, exe, ()):
+                    path = None
+        except OSError as e:
+            log.warning("http_load build unavailable (%s)", e)
+            path = None
+        _cache["http_load"] = path
+        return path
 
 
 def load_native_plog():
