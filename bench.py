@@ -1097,6 +1097,9 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             for _ in range(ticks):
                 node.tick()
             node.overlap_hook = None
+            # Retire the async publisher's backlog: the rate counts a
+            # commit only once it reached the apply plane.
+            node.publish_flush()
             committed = applied + drain(node, apply=True)
             if kv_native is not None:
                 # The C plane applied inside _publish; the queue drain
@@ -1153,6 +1156,7 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                 drain(node, apply=True, t0q=t0q, lats=lats)
         for _ in range(6):
             node.tick()
+            node.publish_flush()    # acks land via the async publisher
             if kv_native is not None:
                 settle_native()
             else:
@@ -1169,9 +1173,16 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             _log(f"  fused durable latency: p50={lat_stats['p50_ms']} ms "
                  f"p99={lat_stats['p99_ms']} ms over {len(lats)} acks, "
                  f"{censored} censored")
+        # On parallel hosts publish runs on its own worker, overlapped
+        # with the next tick's device+wal phases — summing it into the
+        # tick would double-count wall time the tick thread never spent.
+        overlapped = node._host_parallel
+        tick_ms = sum(v for k, v in phase.items()
+                      if not (overlapped and k == "publish"))
         return best, {"durable_mode": "fused", "durable_sm": sm_kind,
                       "durable_phase_ms": phase,
-                      "durable_tick_ms": round(sum(phase.values()), 3),
+                      "durable_phase_overlap": overlapped,
+                      "durable_tick_ms": round(tick_ms, 3),
                       "durable_lat": lat_stats,
                       "repeat_rates": repeat_rates}
     finally:
