@@ -97,6 +97,77 @@ def test_metrics_endpoint(server):
     assert {"ticks", "proposals", "commits", "msgs_sent"} <= set(m)
 
 
+def test_concurrent_puts_all_ack(server):
+    """Many keep-alive connections proposing at once: every PUT must
+    block until ITS commit+apply and ack 204 (httpapi.go:38-49 under
+    raftsql_test.go:79-90-style concurrency); the applied row count
+    equals the acked request count."""
+    import threading
+
+    r, _ = req(server, "PUT", b"CREATE TABLE main.c (v text)")
+    assert r.status == 204
+    n_threads, per = 12, 8
+    errs: list = []
+
+    def worker(i):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            try:
+                for k in range(per):
+                    r, data = req(server, "PUT",
+                                  f"INSERT INTO main.c (v) VALUES"
+                                  f" ('t{i}_{k}')".encode(), conn=conn)
+                    if r.status != 204:
+                        errs.append((i, k, r.status, data))
+            finally:
+                conn.close()
+        except Exception as e:          # noqa: BLE001 - must surface
+            errs.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:3]
+    r, data = req(server, "GET", b"SELECT count(*) FROM main.c")
+    assert r.status == 200
+    assert data == f"|{n_threads * per}|\n".encode()
+
+
+def test_pipelined_requests_answer_in_order(server):
+    """Two requests written back-to-back before any response is read:
+    both planes must answer in order on the same connection (the aio
+    state machine buffers the second while the first is in flight)."""
+    import socket
+
+    body1 = b"CREATE TABLE main.p (v text)"
+    body2 = b"INSERT INTO main.p (v) VALUES ('x')"
+    raw = b"".join(
+        b"PUT / HTTP/1.1\r\nHost: t\r\nContent-Length: "
+        + str(len(b)).encode() + b"\r\n\r\n" + b
+        for b in (body1, body2))
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=30)
+    try:
+        s.sendall(raw)
+        buf = b""
+        deadline = 30
+        import time
+        t0 = time.monotonic()
+        while buf.count(b"HTTP/1.1 ") < 2:    # any two responses
+            assert time.monotonic() - t0 < deadline, buf
+            chunk = s.recv(4096)
+            assert chunk, buf
+            buf += chunk
+        assert buf.count(b"HTTP/1.1 204") == 2, buf
+    finally:
+        s.close()
+    r, data = req(server, "GET", b"SELECT count(*) FROM main.p")
+    assert r.status == 200 and data == b"|1|\n"
+
+
 def test_group_header_routes_to_second_group(server):
     r, _ = req(server, "PUT", b"CREATE TABLE main.g1 (v text)",
                headers={"X-Raft-Group": "1"})
