@@ -707,7 +707,14 @@ class FusedClusterNode:
         # host core the cluster shares with its clients.
         self._spin_hot = tick_active or dev_busy or bool(self._queued)
         if base_active:
-            self._pending_pinfo = pinfo      # next tick overlaps it
+            if self._host_parallel:
+                # The publisher worker IS the overlap: hand the tick's
+                # commits over right after the durable barrier instead
+                # of deferring to the next tick's dispatch window —
+                # one whole tick less propose→ack latency.
+                self._pub_q.put(pinfo)
+            else:
+                self._pending_pinfo = pinfo  # next tick overlaps it
         else:
             # About to go quiet: deliver this tick's commits NOW (they
             # are fsynced above) instead of deferring to a next tick
