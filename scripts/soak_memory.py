@@ -39,6 +39,16 @@ def rss_mb() -> float:
 
 
 def main() -> None:
+    # Honor JAX_PLATFORMS against the axon sitecustomize, which captures
+    # jax_platforms at interpreter start: without the live-config pin a
+    # `JAX_PLATFORMS=cpu` run still inits the default (tunnel) backend
+    # and hangs whenever the tunnel is wedged (the exact hazard
+    # documented in tests/conftest.py and __graft_entry__.py).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--groups", type=int, default=100_000)
     ap.add_argument("--peers", type=int, default=3)
@@ -66,7 +76,13 @@ def main() -> None:
             break
     print(f"elected all groups at tick {node.metrics.ticks}", flush=True)
 
+    from raftsql_tpu.runtime.db import _expand_commit_item
+
     def drain(q):
+        # _expand_commit_item understands every live queue-item shape
+        # (per-group RAW_PLAIN batches AND the whole-tick RAW_MANY item
+        # the fused publish emits since the one-item-per-tick change) —
+        # counting raw tuples undercounted a full tick's commits as 1.
         n = 0
         while True:
             try:
@@ -74,7 +90,7 @@ def main() -> None:
             except Exception:
                 return n
             if isinstance(item, tuple):
-                n += len(item[3]) if len(item) == 4 else 1
+                n += len(_expand_commit_item(item))
 
     committed = 0
     t0 = time.perf_counter()
