@@ -1180,6 +1180,7 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         tick_ms = sum(v for k, v in phase.items()
                       if not (overlapped and k == "publish"))
         return best, {"durable_mode": "fused", "durable_sm": sm_kind,
+                      "durable_steps": node._steps,
                       "durable_phase_ms": phase,
                       "durable_phase_overlap": overlapped,
                       "durable_tick_ms": round(tick_ms, 3),
@@ -1623,7 +1624,16 @@ def main() -> None:
                        # the C++ apply plane): E=64 beats 32 (768k vs
                        # 525k commits/s) and 128 (590k — WAL bytes
                        # dominate past the framing amortization).
-                       "BENCH_E": os.environ.get("BENCH_E", "64")},
+                       "BENCH_E": os.environ.get("BENCH_E", "64"),
+                       # Multi-step dispatch: the on-device durable
+                       # tick is dispatch-overhead-bound through the
+                       # tunnel (r5: 1219 ms/tick at G=1000); S steps
+                       # per dispatch amortize it S-fold at the cost
+                       # of S x device compute (cheap there).  CPU
+                       # measurement: -13% throughput, p99 220->143ms.
+                       "RAFTSQL_FUSED_STEPS": os.environ.get(
+                           "RAFTSQL_FUSED_STEPS",
+                           os.environ.get("BENCH_TPU_STEPS", "8"))},
             label="durable-tpu-fused")
 
     # -- 3. durable-path children (host runtime measured on cpu):

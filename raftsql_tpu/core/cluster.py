@@ -102,6 +102,43 @@ def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))
+def cluster_multistep_host(cfg: RaftConfig, states: PeerState,
+                           inboxes: Inbox, steps: int, prop_n: jax.Array):
+    """`steps` fused steps in ONE dispatch, for the co-located durable
+    runtime (runtime/fused.py steps_per_dispatch): device dispatch
+    overhead — the dominant per-tick cost through a remote-device
+    tunnel — is paid once per S consensus steps instead of once per
+    step, and a proposal entering at the dispatch boundary commits
+    INSIDE the dispatch (the 3-step pipeline runs to completion before
+    the host's durable barrier).
+
+    Safe for the single-process cluster only: intra-dispatch message
+    exchange is not individually durable, which is sound there because
+    the process is the failure domain — a crash loses every peer at
+    once and replay rebuilds from the WALs the host wrote (all S steps'
+    appends + the final hard state) before anything was published.
+
+    Proposals feed the FIRST step only; packed host-facing info returns
+    PER STEP, stacked [S, P, G, C], so the host replays its durable
+    phases in step order.  busy is OR-reduced across steps."""
+    from raftsql_tpu.config import MSG_REQ, MSG_RESP
+    zero = jnp.zeros_like(prop_n)
+
+    def body(carry, s):
+        st, ib = carry
+        st, ib, info = cluster_step(cfg, st, ib,
+                                    jnp.where(s == 0, prop_n, zero))
+        busy_s = (jnp.any(ib.v_type != 0)
+                  | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
+                  | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))
+        return (st, ib), (jax.vmap(pack_info)(info), busy_s)
+
+    (states, inboxes), (pinfos, busys) = jax.lax.scan(
+        body, (states, inboxes), jnp.arange(steps), length=steps)
+    return states, inboxes, pinfos, jnp.any(busys)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))
 def cluster_run(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
                 num_ticks: int, prop_n: jax.Array
                 ) -> Tuple[PeerState, Inbox, StepInfo]:

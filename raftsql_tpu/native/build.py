@@ -225,6 +225,9 @@ def load_native_wal():
         lib.wal_set_snapshot.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_uint64]
+        lib.wal_epoch.restype = ctypes.c_int
+        lib.wal_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8]
         lib.wal_set_compact.restype = ctypes.c_int
         lib.wal_set_compact.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,

@@ -193,6 +193,21 @@ int wal_set_snapshot(void* h, uint32_t group, uint64_t index,
 // below `index` are dropped while the retained suffix SURVIVES — unlike
 // the snapshot marker (type 3), which also clears the suffix because an
 // installed state's history may conflict with it.
+// Type 6 EPOCH: u8 kind (0 BEGIN / 1 END) | u64 epoch number — the
+// multi-step dispatch frame marker (runtime/fused.py); replay ignores
+// it, repair_epochs() truncates at an uncommitted BEGIN.
+int wal_epoch(void* h, uint64_t no, uint8_t kind) {
+  Wal* w = static_cast<Wal*>(h);
+  std::vector<uint8_t> body;
+  body.reserve(10);
+  body.push_back(6);
+  body.push_back(kind);
+  put_u64(body, no);
+  std::lock_guard<std::mutex> lk(w->mu);
+  frame(w, body);
+  return 0;
+}
+
 int wal_set_compact(void* h, uint32_t group, uint64_t index,
                     uint64_t term) {
   Wal* w = static_cast<Wal*>(h);

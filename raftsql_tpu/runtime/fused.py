@@ -52,7 +52,8 @@ import jax
 import jax.numpy as jnp
 
 from raftsql_tpu.config import RaftConfig
-from raftsql_tpu.core.cluster import (cluster_step_host,
+from raftsql_tpu.core.cluster import (cluster_multistep_host,
+                                      cluster_step_host,
                                       empty_cluster_inbox,
                                       init_cluster_state)
 from raftsql_tpu.core.state import restore_peer_state
@@ -64,6 +65,27 @@ from raftsql_tpu.storage.wal import WAL, wal_exists, wal_mirror_all
 from raftsql_tpu.utils.metrics import NodeMetrics
 
 _C = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+
+def _read_committed_epoch(path: str) -> int:
+    """Last valid (u64 no, u32 crc) record of the epoch-commit file; 0
+    when missing/empty.  A torn trailing record (crash mid-append)
+    falls back to the previous one — the dispatch it would have
+    committed is dropped by WAL.repair_epochs, which is exactly the
+    uncommitted-dispatch semantics."""
+    import struct
+    import zlib
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return 0
+    no = 0
+    for off in range(0, len(blob) - 11, 12):
+        n, crc = struct.unpack_from("<QI", blob, off)
+        if zlib.crc32(blob[off:off + 8]) == crc:
+            no = n
+    return no
 
 
 def _expand_ranges(groups, starts, counts):
@@ -166,6 +188,17 @@ class FusedClusterNode:
         # tick of ack latency.  Saturated ticks keep the deferral.
         self._inline_publish_max = int(os.environ.get(
             "RAFTSQL_PUBLISH_INLINE_MAX", "4096"))
+        # Steps per dispatch (RAFTSQL_FUSED_STEPS, default 1): run S
+        # consensus steps inside one device program and replay the
+        # durable phases per step on return (core/cluster.py
+        # cluster_multistep_host).  Amortizes dispatch overhead — the
+        # dominant per-tick cost through a remote-device tunnel — and
+        # lets a proposal commit within ONE dispatch (the 3-step
+        # pipeline completes before the durable barrier).  Election /
+        # heartbeat timers advance once per STEP, so election_ticks
+        # continue to mean steps, not dispatches.
+        self._steps = max(1, int(os.environ.get(
+            "RAFTSQL_FUSED_STEPS", "1")))
         # Publisher worker (parallel hosts): delivering a tick's
         # (already durable) commits to the apply plane costs ~40% of a
         # saturated tick's wall time; a single ordered worker takes it
@@ -190,6 +223,27 @@ class FusedClusterNode:
         self._plog_lib = (load_native_plog()
                           if os.environ.get("RAFTSQL_FUSED_NATIVE_PLOG")
                           == "1" else None)
+
+        # Multi-step dispatch epoch state (see tick()): the committed
+        # epoch lives in data_dir/EPOCHS (12-byte records, fsynced once
+        # per multi-step dispatch AFTER every peer's WAL barrier — the
+        # cluster-atomic commit point).  Before any replay, drop every
+        # peer's trailing UNCOMMITTED dispatch: within a dispatch peers
+        # observe each other's un-fsynced messages, and the per-peer
+        # barrier is not atomic, so a crash mid-barrier must erase the
+        # whole dispatch everywhere or a vote/append observed by one
+        # peer could survive while its sender's record did not (two
+        # leaders in one term after replay).
+        self._epoch_path = os.path.join(data_dir, "EPOCHS")
+        self._epoch_no = _read_committed_epoch(self._epoch_path)
+        self._epoch_f = None
+        self._ep_active = False
+        self._ep_begun = [False] * P
+        self._ep_no_this: Optional[int] = None
+        if os.path.exists(self._epoch_path):
+            for d in self.dirs:
+                if wal_exists(d):
+                    WAL.repair_epochs(d, self._epoch_no)
 
         states = []
         for p in range(P):
@@ -393,6 +447,33 @@ class FusedClusterNode:
         if self.error is not None:
             raise self.error
 
+    def _ensure_epoch_begin(self, p: int) -> None:
+        """Lazily open peer p's dispatch frame: the BEGIN marker is
+        written only when the dispatch actually writes to that peer's
+        WAL (an idle multi-step tick costs zero records and zero epoch
+        fsyncs).  Safe from the per-peer workers: each touches only its
+        own slot, and the epoch-number allocation is idempotent."""
+        if not self._ep_active or self._ep_begun[p]:
+            return
+        if self._ep_no_this is None:
+            self._ep_no_this = self._epoch_no + 1
+        self._ep_begun[p] = True
+        self.wals[p].epoch_mark(self._ep_no_this, end=False)
+
+    def _commit_epoch(self, no: int) -> None:
+        """The multi-step dispatch's atomic commit point: append the
+        epoch number to data_dir/EPOCHS and fsync it — AFTER every
+        peer's WAL barrier, BEFORE publish.  Recovery drops any
+        dispatch whose number never made it here."""
+        import struct
+        import zlib
+        if self._epoch_f is None:
+            self._epoch_f = open(self._epoch_path, "ab")
+        rec = struct.pack("<Q", no)
+        self._epoch_f.write(rec + struct.pack("<I", zlib.crc32(rec)))
+        self._epoch_f.flush()
+        os.fsync(self._epoch_f.fileno())
+
     def _save_hard(self, p: int, pinfo: np.ndarray) -> bool:
         """Write peer p's changed hard states (term/vote/commit) to its
         WAL, AFTER the tick's entry records (etcd wal.Save order: a
@@ -405,6 +486,7 @@ class FusedClusterNode:
         changed = np.nonzero((hs != self._hard[p]).any(axis=1))[0]
         if not changed.size:
             return False
+        self._ensure_epoch_begin(p)
         self.wals[p].set_hardstates(changed, hs[changed, 0],
                                     hs[changed, 1], hs[changed, 2])
         self._hard[p][changed] = hs[changed]
@@ -415,6 +497,12 @@ class FusedClusterNode:
         device busy bit or None).  MeshClusterNode overrides this with
         the shard_map'd step — the durable host plane below is identical
         either way."""
+        if self._steps > 1:
+            self.states, self.inboxes, pinfos_dev, busy = \
+                cluster_multistep_host(self.cfg, self.states,
+                                       self.inboxes, self._steps,
+                                       jnp.asarray(prop_n))
+            return pinfos_dev, busy
         self.states, self.inboxes, pinfo_dev, busy = cluster_step_host(
             self.cfg, self.states, self.inboxes, jnp.asarray(prop_n))
         return pinfo_dev, busy
@@ -431,8 +519,6 @@ class FusedClusterNode:
         it; publish always runs after the save of the tick it publishes.
         """
         import time as _t
-        cfg = self.cfg
-        P = cfg.num_peers
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n()
@@ -467,20 +553,113 @@ class FusedClusterNode:
             dev_busy = True
         t3 = _t.monotonic()
 
+        # Multi-step dispatch (RAFTSQL_FUSED_STEPS > 1): packed info
+        # arrives stacked [S, P, G, C]; the host replays its durable
+        # phases in step order — every step's entries land before the
+        # ONE hard-state save + fsync barrier of the dispatch, which
+        # preserves the etcd wal.Save order (entries-then-hardstate)
+        # at dispatch granularity.
+        step_infos = ([np.asarray(pinfo[s])
+                       for s in range(pinfo.shape[0])]
+                      if pinfo.ndim == 4 else [pinfo])
+        pinfo = step_infos[-1]
         self._hints = pinfo[0, :, _C["leader_hint"]]
+        # Multi-step dispatches are epoch-framed (see _ensure_epoch_
+        # begin / _commit_epoch): BEGIN lazily wraps each peer's first
+        # write, END lands before its fsync, and the dispatch commits
+        # atomically below.
+        self._ep_active = len(step_infos) > 1
+        if self._ep_active:
+            self._ep_begun = [False] * self.cfg.num_peers
+            self._ep_no_this = None
+        tick_active = False
+        for si, pi in enumerate(step_infos):
+            tick_active = self._durable_phases(
+                pi, final=(si == len(step_infos) - 1)) or tick_active
+        if self._ep_active and self._ep_no_this is not None:
+            # Every peer's barrier is down; this fsync is the
+            # dispatch's atomic commit point (before any publish).
+            self._epoch_no = self._ep_no_this
+            self._commit_epoch(self._epoch_no)
+        self._ep_active = False
+        t4 = _t.monotonic()
+        # Quiescence signal for the threaded loop: anything written,
+        # any group leaderless, or any proposal backlog means "keep
+        # ticking at full pace".
+        base_active = (tick_active
+                       or dev_busy
+                       or bool((self._hints < 0).any())
+                       or bool(self._queued))
+        # HOT means real client work is flowing (writes this tick, a
+        # device dispatch still in flight, or a proposal backlog): the
+        # threaded loop then ticks back-to-back.  Merely-leaderless
+        # groups keep the loop ACTIVE (elections must advance) but not
+        # hot — warmup paces at interval_s instead of starving the
+        # host core the cluster shares with its clients.
+        self._spin_hot = tick_active or dev_busy or bool(self._queued)
+        if base_active:
+            if self._host_parallel:
+                # The publisher worker IS the overlap: hand the tick's
+                # commits over right after the durable barrier instead
+                # of deferring to the next tick's dispatch window —
+                # one whole tick less propose→ack latency.
+                self._pub_q.put(pinfo)
+            else:
+                # Serial host: defer-and-overlap pays only when the
+                # publish is expensive.  A light tick's batch (a few
+                # serving requests) costs far less to deliver NOW than
+                # the whole tick of ack latency the deferral adds.
+                delta = int(np.clip(
+                    pinfo[0][:, _C["commit"]] - self._applied[0],
+                    0, None).sum())
+                if delta <= self._inline_publish_max:
+                    tp = _t.monotonic()
+                    self._publish(pinfo)
+                    self.metrics.t_publish_ms += \
+                        (_t.monotonic() - tp) * 1e3
+                    self._pending_pinfo = None
+                else:
+                    self._pending_pinfo = pinfo  # next tick overlaps
+        else:
+            # About to go quiet: deliver this tick's commits NOW (they
+            # are fsynced above) instead of deferring to a next tick
+            # that may be a parked 0.5s away — the deferral only pays
+            # when another dispatch immediately follows to overlap.
+            if self._host_parallel:
+                self._pub_q.put(pinfo)
+            else:
+                tp = _t.monotonic()
+                self._publish(pinfo)
+                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+            self._pending_pinfo = None
+        self._tick_active = base_active
+        self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
+        self.metrics.t_wal_ms += (t4 - t3) * 1e3
+        self._tick_no += 1
+        self.metrics.ticks += 1
 
-        # Phase 1: collect mirror METADATA (peer, src, group, start,
-        # count, new_len) — no reads here.  Mirror-source staging (the
-        # same-tick truncation hazard in the module doc) happens inside
-        # phase 2b, AFTER phase 2a's leader appends; that is safe
-        # because 2a writes are pure TAIL appends at positions strictly
-        # above any mirrored range (a mirror range was composed from
-        # the source's ring at end of t-1, so it ends at or below the
-        # source's t-1 length), and the only same-tick writes that can
-        # truncate or overwrite a mirrored range are OTHER MIRRORS —
-        # which both 2b paths stage fully before writing.  Any future
-        # 2a change that is not a pure tail append breaks this
-        # argument and must move 2a after 2b's staging.
+
+    def _durable_phases(self, pinfo: np.ndarray, final: bool) -> bool:
+        """The durable host phases for ONE step's packed info [P,G,C]:
+        phase 1 collects mirror METADATA (peer, src, group, start,
+        count, new_len) with no reads; phase 2a writes leader appends
+        (fresh-leader no-ops + accepted proposals) as uniform-term
+        RANGES; phase 2b mirrors follower appends.  Mirror-source
+        staging happens inside 2b AFTER 2a's appends — safe because 2a
+        writes are pure TAIL appends strictly above any mirrored range
+        (mirror ranges were composed from the source's ring at the end
+        of the PREVIOUS step), and the only same-step writes that can
+        truncate or overwrite a mirrored range are OTHER MIRRORS, which
+        both 2b paths stage fully before writing.  Any future 2a change
+        that is not a pure tail append breaks this argument and must
+        move 2a after 2b's staging.
+
+        On the dispatch's FINAL step only, phase 2c (hard states) and
+        the per-peer fsync barrier run — a multi-step dispatch saves
+        every step's entries, then one hard state, then one fsync,
+        which is the etcd wal.Save order at dispatch granularity.
+        Returns tick_active (entries or hard states written)."""
+        P = self.cfg.num_peers
         m_peer: List[int] = []
         m_src: List[int] = []
         m_g: List[int] = []
@@ -547,6 +726,7 @@ class FusedClusterNode:
             if not r_g:
                 continue
             tick_active = True
+            self._ensure_epoch_begin(p)
             plog_native = (self.plogs[p]
                            if hasattr(self.plogs[p], "handle") else None)
             wrote = False
@@ -580,7 +760,8 @@ class FusedClusterNode:
         # workers, and every C structure carries its own mutex.  This
         # overlaps the 3x payload memcpy + write + fsync across cores
         # instead of serializing them on the tick thread.
-        par_ok = (self._host_parallel
+        par_ok = (final
+                  and self._host_parallel
                   and self.wals
                   and self.wals[0]._lib is not None
                   and hasattr(self.wals[0]._lib, "walplog_mirror_all")
@@ -610,6 +791,7 @@ class FusedClusterNode:
             def _host_peer(p: int) -> bool:
                 idx = by_peer[p]
                 if idx:
+                    self._ensure_epoch_begin(p)
                     wal_mirror_all(
                         self.wals, self.plogs,
                         [m_peer[i] for i in idx],
@@ -619,12 +801,16 @@ class FusedClusterNode:
                         [m_count[i] for i in idx],
                         [m_newlen[i] for i in idx])
                 changed = self._save_hard(p, pinfo)
+                if self._ep_begun[p]:
+                    self.wals[p].epoch_mark(self._ep_no_this, end=True)
                 self.wals[p].sync()
                 return changed
 
             for act in self._sync_pool.map(_host_peer, range(P)):
                 tick_active = tick_active or act
         elif m_peer:
+            for p in sorted(set(m_peer)):
+                self._ensure_epoch_begin(p)
             if not wal_mirror_all(self.wals, self.plogs, m_peer, m_src,
                                   m_g, m_start, m_count, m_newlen):
                 # Python two-pass fallback: ALL source reads first (the
@@ -687,9 +873,14 @@ class FusedClusterNode:
         # tail can then never leave a hard state referencing lost
         # entries), then the per-peer fsync that is the durable barrier
         # before the next dispatch.
-        if not par_ok:
+        if final and not par_ok:
             for p in range(P):
                 tick_active = self._save_hard(p, pinfo) or tick_active
+            if self._ep_active:
+                for p in range(P):
+                    if self._ep_begun[p]:
+                        self.wals[p].epoch_mark(self._ep_no_this,
+                                                end=True)
             # The durable barrier: every peer fsynced before this
             # tick's messages can be observed (the next dispatch).  The
             # P fsyncs are independent files — run them concurrently
@@ -697,61 +888,7 @@ class FusedClusterNode:
             # so the barrier costs one fsync wall-time, not P.  A peer
             # with nothing pending returns immediately.
             list(self._sync_pool.map(lambda w: w.sync(), self.wals))
-        t4 = _t.monotonic()
-        # Quiescence signal for the threaded loop: anything written,
-        # any group leaderless, or any proposal backlog means "keep
-        # ticking at full pace".
-        base_active = (tick_active
-                       or dev_busy
-                       or bool((self._hints < 0).any())
-                       or bool(self._queued))
-        # HOT means real client work is flowing (writes this tick, a
-        # device dispatch still in flight, or a proposal backlog): the
-        # threaded loop then ticks back-to-back.  Merely-leaderless
-        # groups keep the loop ACTIVE (elections must advance) but not
-        # hot — warmup paces at interval_s instead of starving the
-        # host core the cluster shares with its clients.
-        self._spin_hot = tick_active or dev_busy or bool(self._queued)
-        if base_active:
-            if self._host_parallel:
-                # The publisher worker IS the overlap: hand the tick's
-                # commits over right after the durable barrier instead
-                # of deferring to the next tick's dispatch window —
-                # one whole tick less propose→ack latency.
-                self._pub_q.put(pinfo)
-            else:
-                # Serial host: defer-and-overlap pays only when the
-                # publish is expensive.  A light tick's batch (a few
-                # serving requests) costs far less to deliver NOW than
-                # the whole tick of ack latency the deferral adds.
-                delta = int(np.clip(
-                    pinfo[0][:, _C["commit"]] - self._applied[0],
-                    0, None).sum())
-                if delta <= self._inline_publish_max:
-                    tp = _t.monotonic()
-                    self._publish(pinfo)
-                    self.metrics.t_publish_ms += \
-                        (_t.monotonic() - tp) * 1e3
-                    self._pending_pinfo = None
-                else:
-                    self._pending_pinfo = pinfo  # next tick overlaps
-        else:
-            # About to go quiet: deliver this tick's commits NOW (they
-            # are fsynced above) instead of deferring to a next tick
-            # that may be a parked 0.5s away — the deferral only pays
-            # when another dispatch immediately follows to overlap.
-            if self._host_parallel:
-                self._pub_q.put(pinfo)
-            else:
-                tp = _t.monotonic()
-                self._publish(pinfo)
-                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
-            self._pending_pinfo = None
-        self._tick_active = base_active
-        self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
-        self.metrics.t_wal_ms += (t4 - t3) * 1e3
-        self._tick_no += 1
-        self.metrics.ticks += 1
+        return tick_active
 
     def _publish(self, pinfo: np.ndarray) -> None:
         """Deliver a saved tick's newly committed entries to each peer's
@@ -869,6 +1006,9 @@ class FusedClusterNode:
         self._pub_q.put(None)                     # drain, then retire
         self._pub_thread.join(timeout=10)
         self._sync_pool.shutdown(wait=True)
+        if self._epoch_f is not None:
+            self._epoch_f.close()
+            self._epoch_f = None
         for w in self.wals:
             w.close()
         for plog in self.plogs:
@@ -937,6 +1077,10 @@ class MeshClusterNode(FusedClusterNode):
         from raftsql_tpu.parallel.sharded import (
             make_sharded_cluster_step_host, shard_cluster_arrays)
         super().__init__(cfg, data_dir, seed)
+        # The sharded step has no multi-step variant: force 1 so a
+        # RAFTSQL_FUSED_STEPS env meant for the single-chip runtime
+        # cannot silently misreport the mesh's dispatch granularity.
+        self._steps = 1
         self.mesh = mesh
         self._sharded_step = make_sharded_cluster_step_host(cfg, mesh)
         # Lay the freshly built (or replayed) cluster state out over the

@@ -69,6 +69,7 @@ _ENTRY = struct.Struct("<BIQQ")      # type, group, index, term
 _HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
 _SNAP = struct.Struct("<BIQQ")       # type, group, index, term (also COMPACT)
 _RANGE = struct.Struct("<BIQQI")     # type, group, start, term, count
+_EPOCH = struct.Struct("<BBQ")       # type, kind (0 BEGIN / 1 END), no
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
@@ -77,6 +78,12 @@ REC_SNAPSHOT = 3        # install boundary: entries <= index AND the
 REC_COMPACT = 4         # compaction floor: entries <= index dropped,
 #                         retained suffix kept
 REC_RANGE = 5           # batched same-term entry run (see module doc)
+REC_EPOCH = 6           # multi-step dispatch frame marker (see
+                        # runtime/fused.py steps_per_dispatch): kind 0 =
+                        # BEGIN, 1 = END, + the dispatch's epoch number.
+                        # Replay ignores these; repair_epochs() uses
+                        # BEGIN markers to atomically drop an
+                        # uncommitted dispatch after a crash.
 
 _SEG_RE = re.compile(r"^wal-(\d+)\.log$")
 # Single source of truth for the default lives in config (the CLI and
@@ -501,6 +508,64 @@ class WAL:
             self._bytes += _HDR.size + _SNAP.size
             return
         self._write(_SNAP.pack(REC_SNAPSHOT, group, index, term))
+
+    def epoch_mark(self, no: int, end: bool) -> None:
+        """Multi-step dispatch frame marker (REC_EPOCH): BEGIN before
+        the dispatch's first record, END after its last (including the
+        hard states).  Replay ignores them; repair_epochs() drops a
+        trailing dispatch whose epoch was never cluster-committed."""
+        if self._lib is not None and hasattr(self._lib, "wal_epoch"):
+            self._lib.wal_epoch(self._h, no, 1 if end else 0)
+            self._pending = True
+            self._bytes += _HDR.size + _EPOCH.size
+            return
+        self._write(_EPOCH.pack(REC_EPOCH, 1 if end else 0, no))
+
+    @staticmethod
+    def repair_epochs(dirname: str, committed: int) -> bool:
+        """Atomically drop an UNCOMMITTED multi-step dispatch: truncate
+        this WAL at the first EPOCH-BEGIN marker whose number exceeds
+        `committed` (the cluster's epoch-commit fsync is the
+        linearization point; see runtime/fused.py) and unlink any later
+        segments.  Runs BEFORE replay/open.  Returns True if anything
+        was dropped.
+
+        Within one dispatch peers exchange messages that are not yet
+        individually durable; the per-peer fsync barrier is not atomic,
+        so a crash mid-barrier can leave peer A's WAL holding effects
+        of a message peer B never persisted.  Dropping the whole
+        uncommitted dispatch on EVERY peer restores the all-or-nothing
+        view — nothing was published (publish follows the epoch-commit
+        fsync), so no client observed it."""
+        cut: Optional[Tuple[str, int]] = None
+        paths = _segment_paths(dirname)
+        for pi, (seq, path) in enumerate(paths):
+            with open(path, "rb") as f:
+                blob = f.read()
+            off = 0
+            while off + _HDR.size <= len(blob):
+                crc, blen = _HDR.unpack_from(blob, off)
+                body = blob[off + _HDR.size: off + _HDR.size + blen]
+                if len(body) != blen or zlib.crc32(body) != crc:
+                    break                    # torn — _repair_tail's job
+                if body[0] == REC_EPOCH:
+                    _, kind, no = _EPOCH.unpack_from(body)
+                    if kind == 0 and no > committed:
+                        cut = (pi, off)
+                        break
+                off += _HDR.size + blen
+            if cut is not None:
+                break
+        if cut is None:
+            return False
+        pi, off = cut
+        with open(paths[pi][1], "r+b") as f:
+            f.truncate(off)
+            f.flush()
+            os.fsync(f.fileno())
+        for _, path in paths[pi + 1:]:
+            os.unlink(path)
+        return True
 
     def _write_compact_rec(self, group: int, index: int, term: int) -> None:
         self._active_stats.bump(group, index)

@@ -6,6 +6,10 @@ device dispatches), crash-restart WAL replay with the nil-sentinel
 protocol (reference raft.go:122-134, 131-132), and KV apply off the
 commit stream.
 """
+import os
+
+import numpy as np
+
 import raftsql_tpu.runtime.fused as fused_mod
 from raftsql_tpu.config import RaftConfig
 from raftsql_tpu.models.kv_sm import KVStateMachine
@@ -247,6 +251,128 @@ def test_fused_native_payload_plane(tmp_path, monkeypatch):
     rep, sent = drain(node2, 0)
     assert sent == 1 and len(rep) == 12
     node2.stop()
+
+
+def test_multistep_dispatch_equals_single_step_ticks(tmp_path):
+    """RAFTSQL_FUSED_STEPS=S must be EXACTLY S single-step ticks: same
+    consensus math (same seed), same durable bytes, same published
+    commits — only the dispatch/barrier granularity changes.  Drives
+    two clusters through the identical step sequence (proposals enter
+    at dispatch boundaries in both) and compares hard states, payload
+    logs, applied KV state, and a restart replay of the multi-step
+    node's WALs."""
+    S = 4
+    cfg = mkcfg()
+    a = FusedClusterNode(cfg, str(tmp_path / "single"), seed=11)
+    b = FusedClusterNode(cfg, str(tmp_path / "multi"), seed=11)
+    b._steps = S
+    try:
+        # Same total warmup steps for both (b ticks S steps at a time).
+        warm = 40 * cfg.election_ticks
+        for _ in range(warm):
+            a.tick()
+        for _ in range(warm // S):
+            b.tick()
+        assert (a._hints >= 0).all() and (b._hints >= 0).all()
+        assert (a._hints == b._hints).all()
+
+        for r in range(6):
+            for g in range(cfg.num_groups):
+                cmds = [f"SET k{r}_{i} g{g}".encode() for i in range(3)]
+                a.propose_many(g, cmds)
+                b.propose_many(g, cmds)
+            for _ in range(S):
+                a.tick()
+            b.tick()
+        for _ in range(2 * S):
+            a.tick()
+        for _ in range(2):
+            b.tick()
+
+        # Identical device-visible state...
+        assert (a._hard == b._hard).all()
+        # ...identical durable payload bytes on every peer...
+        for p in range(cfg.num_peers):
+            for g in range(cfg.num_groups):
+                assert a.plogs[p].length(g) == b.plogs[p].length(g)
+                n = a.plogs[p].length(g)
+                ta_, da_ = a.plogs[p].slice_columns(g, 1, n)
+                tb_, db_ = b.plogs[p].slice_columns(g, 1, n)
+                assert list(ta_) == list(tb_) and list(da_) == list(db_)
+        # ...identical published commit streams (as applied KV state).
+        def applied_state(node):
+            sms = [KVStateMachine() for _ in range(cfg.num_groups)]
+            items, _ = drain(node, 0)
+            for (g, idx, cmd) in items:
+                assert sms[g].apply(cmd, idx) is None
+            return [sm.snapshot() for sm in sms]
+        assert applied_state(a) == applied_state(b)
+    finally:
+        a.stop()
+        b.stop()
+
+    # The multi-step node's WALs replay to the same state.
+    c = FusedClusterNode(cfg, str(tmp_path / "multi"), seed=11)
+    try:
+        assert (c._hard == b._hard).all()
+    finally:
+        c.stop()
+
+
+def test_multistep_uncommitted_dispatch_dropped_on_restart(tmp_path):
+    """Crash mid-barrier atomicity: a multi-step dispatch fsynced on
+    SOME peers but never epoch-committed must vanish everywhere on
+    restart — otherwise one peer could durably remember observing a
+    message (vote grant, append) its sender never persisted, the
+    classic two-leaders-in-one-term replay hazard."""
+    S = 4
+    cfg = mkcfg()
+    d = str(tmp_path / "n")
+    node = FusedClusterNode(cfg, d, seed=5)
+    node._steps = S
+    try:
+        elect(node)
+        for g in range(cfg.num_groups):
+            node.propose_many(g, [b"SET a 1", b"SET b 2"])
+        for _ in range(4):
+            node.tick()
+        node.publish_flush()
+        lens = [[node.plogs[p].length(g) for g in range(cfg.num_groups)]
+                for p in range(cfg.num_peers)]
+        hard = node._hard.copy()
+        committed_epoch = node._epoch_no
+        assert committed_epoch > 0       # multi-step framing was live
+    finally:
+        node.stop()
+
+    # Simulate the crash: peer 0's WAL gains a complete dispatch frame
+    # (BEGIN + entries + hard state + END) and even fsyncs it, but the
+    # cluster epoch-commit never happened; peer 1 tore mid-frame
+    # (BEGIN only).  Peer 2 wrote nothing.
+    w0 = WAL(os.path.join(d, "p1"))
+    w0.epoch_mark(committed_epoch + 1, end=False)
+    w0.append_ranges([0], [lens[0][0] + 1], [1], [99], [b"SET z 9"])
+    w0.set_hardstates(np.array([0]), np.array([99]), np.array([-1]),
+                      np.array([lens[0][0] + 1]))
+    w0.epoch_mark(committed_epoch + 1, end=True)
+    w0.sync()
+    w0.close()
+    w1 = WAL(os.path.join(d, "p2"))
+    w1.epoch_mark(committed_epoch + 1, end=False)
+    w1.sync()
+    w1.close()
+
+    node2 = FusedClusterNode(cfg, d, seed=5)
+    try:
+        # The whole uncommitted dispatch is gone on every peer: same
+        # payload lengths, same hard states as before the "crash".
+        for p in range(cfg.num_peers):
+            for g in range(cfg.num_groups):
+                assert node2.plogs[p].length(g) == lens[p][g], (p, g)
+        assert (node2._hard == hard).all()
+        assert node2._epoch_no == committed_epoch
+    finally:
+        node2.stop()
 
 
 def test_fused_crash_with_torn_tail_recovers(tmp_path):
