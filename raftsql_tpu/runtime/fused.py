@@ -114,6 +114,10 @@ class FusedClusterNode:
     CLOSED ends the stream), `leader_of(group)` reports the last hint.
     """
 
+    # Epoch-commit file rotation threshold (12 bytes/dispatch; only the
+    # last record matters — see _commit_epoch).
+    _EPOCH_ROTATE_BYTES = 1 << 20
+
     def __init__(self, cfg: RaftConfig, data_dir: str,
                  seed: Optional[int] = None):
         P, G = cfg.num_peers, cfg.num_groups
@@ -473,6 +477,24 @@ class FusedClusterNode:
         self._epoch_f.write(rec + struct.pack("<I", zlib.crc32(rec)))
         self._epoch_f.flush()
         os.fsync(self._epoch_f.fileno())
+        if self._epoch_f.tell() >= self._EPOCH_ROTATE_BYTES:
+            # Rotate: only the LAST record matters for recovery.  Write
+            # a one-record replacement beside the live file, fsync it,
+            # atomically swap (rename is the commit), fsync the dir.
+            tmp = self._epoch_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(rec + struct.pack("<I", zlib.crc32(rec)))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._epoch_path)
+            dfd = os.open(os.path.dirname(self._epoch_path) or ".",
+                          os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            self._epoch_f.close()
+            self._epoch_f = open(self._epoch_path, "ab")
 
     def _save_hard(self, p: int, pinfo: np.ndarray) -> bool:
         """Write peer p's changed hard states (term/vote/commit) to its

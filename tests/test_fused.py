@@ -375,6 +375,32 @@ def test_multistep_uncommitted_dispatch_dropped_on_restart(tmp_path):
         node2.stop()
 
 
+def test_epoch_commit_file_rotates_and_recovers(tmp_path):
+    """The epoch-commit file keeps only what recovery needs: rotation
+    rewrites it to the newest record once it crosses the threshold, and
+    a restart reads the committed epoch back across rotations."""
+    from raftsql_tpu.runtime.fused import _read_committed_epoch
+
+    cfg = mkcfg(groups=2)
+    d = str(tmp_path / "n")
+    n = FusedClusterNode(cfg, d)
+    n._EPOCH_ROTATE_BYTES = 60          # rotate every 5 records
+    try:
+        for i in range(23):
+            n._commit_epoch(i + 1)
+        n._epoch_no = 23
+    finally:
+        n.stop()
+    path = os.path.join(d, "EPOCHS")
+    assert os.path.getsize(path) <= 60  # bounded by rotation
+    assert _read_committed_epoch(path) == 23
+    n2 = FusedClusterNode(cfg, d)
+    try:
+        assert n2._epoch_no == 23
+    finally:
+        n2.stop()
+
+
 def test_fused_crash_with_torn_tail_recovers(tmp_path):
     """Hard-crash recovery: no graceful stop (buffered frames lost), a
     torn half-record appended to one peer's active segment — replay
