@@ -1076,8 +1076,12 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
             m = node.metrics
             m.ticks = 0
             m.t_device_ms = m.t_wal_ms = m.t_publish_ms = 0.0
-            cmds = ([mk_cmd] * (ticks * E) if mk_cmd is not None else
-                    [f"SET k{i} v".encode() for i in range(ticks * E)])
+            # Backlog for the whole run: each multi-step dispatch
+            # drains S x E per group, so scale by steps or the later
+            # dispatches run empty and dilute the rate.
+            per_g = ticks * E * node._steps
+            cmds = ([mk_cmd] * per_g if mk_cmd is not None else
+                    [f"SET k{i} v".encode() for i in range(per_g)])
             for g in range(active):
                 node.propose_many(g, cmds)
             drain(node, apply=False)

@@ -118,23 +118,23 @@ def cluster_multistep_host(cfg: RaftConfig, states: PeerState,
     once and replay rebuilds from the WALs the host wrote (all S steps'
     appends + the final hard state) before anything was published.
 
-    Proposals feed the FIRST step only; packed host-facing info returns
-    PER STEP, stacked [S, P, G, C], so the host replays its durable
-    phases in step order.  busy is OR-reduced across steps."""
+    Proposals arrive PER STEP (`prop_n` is [S, P, G] — the host chunks
+    its backlog ≤E per step, so one dispatch accepts and commits up to
+    S×E per group); packed host-facing info returns PER STEP, stacked
+    [S, P, G, C], so the host replays its durable phases in step
+    order.  busy is OR-reduced across steps."""
     from raftsql_tpu.config import MSG_REQ, MSG_RESP
-    zero = jnp.zeros_like(prop_n)
 
-    def body(carry, s):
+    def body(carry, prop_t):
         st, ib = carry
-        st, ib, info = cluster_step(cfg, st, ib,
-                                    jnp.where(s == 0, prop_n, zero))
+        st, ib, info = cluster_step(cfg, st, ib, prop_t)
         busy_s = (jnp.any(ib.v_type != 0)
                   | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
                   | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))
         return (st, ib), (jax.vmap(pack_info)(info), busy_s)
 
     (states, inboxes), (pinfos, busys) = jax.lax.scan(
-        body, (states, inboxes), jnp.arange(steps), length=steps)
+        body, (states, inboxes), prop_n, length=steps)
     return states, inboxes, pinfos, jnp.any(busys)
 
 

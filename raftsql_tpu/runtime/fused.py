@@ -391,8 +391,17 @@ class FusedClusterNode:
 
     # -- the tick -------------------------------------------------------
 
-    def _build_prop_n(self) -> np.ndarray:
+    def _build_prop_n(self, steps: int = 1) -> np.ndarray:
+        """Per-dispatch proposal counts.  steps == 1: [P, G], up to E
+        per group.  steps > 1 (multi-step dispatch): [S, P, G] — each
+        step gets its own ≤E chunk of the backlog, so one dispatch can
+        accept (and commit) up to S×E per group.  The device may accept
+        less at any step (window pressure); the host pops exactly what
+        each step REPORTS accepted, in step order, and offers were cut
+        from one backlog snapshot — so pops never outrun the queue and
+        payloads stay aligned with the device's assigned indexes."""
         P, G = self.cfg.num_peers, self.cfg.num_groups
+        cap = self._E * steps
         prop_n = np.zeros((P, G), np.int32)
         dead = []
         with self._prop_lock:
@@ -409,10 +418,13 @@ class FusedClusterNode:
                     self._queued.add((h, g))
                     dead.append((p, g))
                     continue
-                prop_n[p, g] = min(len(q), self._E)
+                prop_n[p, g] = min(len(q), cap)
             for k in dead:
                 self._queued.discard(k)
-        return prop_n
+        if steps <= 1:
+            return prop_n
+        return np.stack([np.clip(prop_n - s * self._E, 0, self._E)
+                         for s in range(steps)]).astype(np.int32)
 
     def _pub_run(self) -> None:
         """Ordered publish worker (see __init__): one queue, one
@@ -543,7 +555,7 @@ class FusedClusterNode:
         import time as _t
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
-        prop_n = self._build_prop_n()
+        prop_n = self._build_prop_n(self._steps)
         pinfo_dev, busy_dev = self._device_step(prop_n)
         t1 = _t.monotonic()
         # Overlap: tick t-1's commits are durable (fsynced last tick).
