@@ -1731,7 +1731,12 @@ def main() -> None:
             "cpu", min(timeout_s, remaining() - fallback_reserve),
             extra_env={"BENCH_CONFIG": "durable",
                        "BENCH_DURABLE_MODE": "fused",
-                       "BENCH_E": os.environ.get("BENCH_E", "64")},
+                       "BENCH_E": os.environ.get("BENCH_E", "64"),
+                       # Interleaved A/B at G=1000/E=64 on one core:
+                       # S=4 wins both pairs (625/681k vs 543/630k) —
+                       # bigger per-dispatch WAL batches.
+                       "RAFTSQL_FUSED_STEPS": os.environ.get(
+                           "RAFTSQL_FUSED_STEPS", "4")},
             label="durable-cpu-fused")
 
     # -- 3b. latency child on the device: ONE small shape (G=1024, E=16)
