@@ -61,7 +61,8 @@ from raftsql_tpu.core.step import INFO_FIELDS
 from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
 from raftsql_tpu.native.build import load_native_plog
 from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
-from raftsql_tpu.storage.wal import WAL, wal_exists, wal_mirror_all
+from raftsql_tpu.storage.wal import (WAL, split_uniform_runs,
+                                      wal_exists, wal_mirror_all)
 from raftsql_tpu.utils.metrics import NodeMetrics
 
 _C = {n: i for i, n in enumerate(INFO_FIELDS)}
@@ -884,19 +885,12 @@ class FusedClusterNode:
                         s_term: List[int] = []
                         pos = 0
                         for g, st0, c in zip(b_g, b_start, b_count):
-                            terms = b_terms[pos: pos + c]
-                            run0 = 0
-                            for i in range(1, c):
-                                if terms[i] != terms[run0]:
-                                    s_g.append(g)
-                                    s_start.append(st0 + run0)
-                                    s_count.append(i - run0)
-                                    s_term.append(terms[run0])
-                                    run0 = i
-                            s_g.append(g)
-                            s_start.append(st0 + run0)
-                            s_count.append(c - run0)
-                            s_term.append(terms[run0])
+                            for (rs, rc, rt) in split_uniform_runs(
+                                    st0, b_terms[pos: pos + c]):
+                                s_g.append(g)
+                                s_start.append(rs)
+                                s_count.append(rc)
+                                s_term.append(rt)
                             pos += c
                         self.wals[p].append_ranges(s_g, s_start, s_count,
                                                    s_term, b_d)

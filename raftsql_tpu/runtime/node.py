@@ -49,7 +49,7 @@ from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
                                           unwrap_snapshot, wrap,
                                           wrap_snapshot)
 from raftsql_tpu.storage.log import PayloadLog
-from raftsql_tpu.storage.wal import WAL, wal_exists
+from raftsql_tpu.storage.wal import WAL, split_uniform_runs, wal_exists
 from raftsql_tpu.transport.base import (AppendRec, ColRecs, ProposalRec,
                                         SnapshotRec, TickBatch, Transport,
                                         VoteRec)
@@ -916,22 +916,25 @@ class RaftNode:
         hard-state delta), so an idle group costs zero Python work — the
         round-1/2 hot loop was O(G) every tick regardless of activity.
         Entry records accumulate across all groups into ONE batched WAL
-        call (the C++ fast path frames them without a per-record Python
-        round trip — native/wal.cc)."""
+        call of uniform-term RANGE runs (type-5 records, the same ~4x
+        framing cut the fused tick measured — storage/wal.py module
+        doc), framed without a per-record Python round trip on the C++
+        fast path (native/wal.cc)."""
         term = np.asarray(info.term)
         noop = np.asarray(info.noop)
         prop_acc = np.asarray(info.prop_accepted)
         app_from = np.asarray(info.app_from)
-        w_groups: List[int] = []
-        w_idx: List[int] = []
-        w_terms: List[int] = []
+        w_rg: List[int] = []         # RANGE runs: group, start, count,
+        w_rs: List[int] = []         # term — plus the flat per-entry
+        w_rc: List[int] = []         # payload list in run order.
+        w_rt: List[int] = []
         w_data: List[bytes] = []
 
-        def put_rec(g: int, idx: int, t: int, data: bytes) -> None:
-            w_groups.append(g)
-            w_idx.append(idx)
-            w_terms.append(t)
-            w_data.append(data)
+        def put_run(g: int, start: int, count: int, t: int) -> None:
+            w_rg.append(g)
+            w_rs.append(start)
+            w_rc.append(count)
+            w_rt.append(t)
 
         active = np.nonzero(noop | (prop_acc > 0) | (app_from >= 0))[0]
         # ONE lock hold pops every group's accepted proposals (a per-group
@@ -952,15 +955,14 @@ class RaftNode:
                 base = int(info.prop_base[g])
                 t_g = int(term[g])
                 if noop[g]:
-                    put_rec(g, base, t_g, b"")
+                    put_run(g, base, 1, t_g)
+                    w_data.append(b"")
                     self.payload_log.put(g, base, [b""], [t_g])
                 if n_acc:
                     batch = popped[g]
-                    # Batched list extends: per-record put_rec calls
-                    # were ~20% of this phase at saturation.
-                    w_groups.extend([g] * n_acc)
-                    w_idx.extend(range(base + 1, base + 1 + n_acc))
-                    w_terms.extend([t_g] * n_acc)
+                    # One uniform-term run for the whole accepted batch
+                    # (leader appends share the leader's term).
+                    put_run(g, base + 1, n_acc, t_g)
                     w_data.extend(batch)
                     self._local[g].extend(
                         zip(range(base + 1, base + 1 + n_acc), batch))
@@ -975,9 +977,9 @@ class RaftNode:
                 start = int(info.app_start[g])
                 new_len = int(info.new_log_len[g])
                 n_app = int(info.app_n[g])
-                w_groups.extend([g] * n_app)
-                w_idx.extend(range(start, start + n_app))
-                w_terms.extend(rec.ent_terms[:n_app])
+                for (rs, rc, rt) in split_uniform_runs(
+                        start, rec.ent_terms[:n_app]):
+                    put_run(g, rs, rc, rt)
                 w_data.extend(rec.payloads[:n_app])
                 self.payload_log.put(g, start, rec.payloads,
                                      rec.ent_terms, new_len=new_len)
@@ -1009,8 +1011,8 @@ class RaftNode:
         hard_changed = np.nonzero((hs != self._hard_np).any(axis=1))[0]
         # Entries land before hard states (etcd wal.Save order): a torn
         # tail can then never leave a hard state referencing lost entries.
-        if w_groups:
-            self.wal.append_entries(w_groups, w_idx, w_terms, w_data)
+        if w_rg:
+            self.wal.append_ranges(w_rg, w_rs, w_rc, w_rt, w_data)
         if hard_changed.size:
             self.wal.set_hardstates(hard_changed, hs[hard_changed, 0],
                                     hs[hard_changed, 1],

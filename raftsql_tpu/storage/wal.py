@@ -154,6 +154,24 @@ class _SegStats:
         return set(self.max_idx) | self.hs
 
 
+def split_uniform_runs(start: int, terms) -> List[Tuple[int, int, int]]:
+    """(start, count, term) uniform-term runs covering positions
+    start..start+len(terms)-1 — the shape RANGE records require.
+    Mirrored batches cross terms only at elections, so the common case
+    is ONE run; the boundary scan is vectorized, no per-entry Python."""
+    import numpy as np
+    n = len(terms)
+    if n == 0:
+        return []
+    ta = np.asarray(terms)
+    bnd = np.flatnonzero(np.diff(ta))
+    if not bnd.size:
+        return [(start, n, int(ta[0]))]
+    edges = [0] + (bnd + 1).tolist() + [n]
+    return [(start + a, b - a, int(ta[a]))
+            for a, b in zip(edges[:-1], edges[1:])]
+
+
 def wal_mirror_all(wals, plogs, peers, srcs, groups, starts, counts,
                    new_lens) -> bool:
     """Cluster-wide follower mirror in ONE native call
