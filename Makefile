@@ -2,8 +2,9 @@
 # vet targets; reference Makefile:1-23), adapted to the Python/C++ tree.
 
 PY ?= python
+SEED ?= 0
 
-.PHONY: all native test vet bench clean
+.PHONY: all native test vet bench chaos clean
 
 # "Build" = compile the native C++ components (storage fast path).
 all: native
@@ -27,6 +28,14 @@ vet:
 
 bench:
 	$(PY) bench.py
+
+# Deterministic chaos scenario (raftsql_tpu/chaos/): seeded partitions,
+# crashes, fsync/torn-write faults + invariant checking, run TWICE and
+# digest-compared to prove the seed reproduces bit-for-bit.
+#   make chaos SEED=17
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --seed $(SEED) --ticks 240 --runs 2
 
 # ThreadSanitizer pass over the native WAL's locking (SURVEY.md §5.2):
 # 4 threads x appends/hardstate/compact/snapshot/sync on one handle.

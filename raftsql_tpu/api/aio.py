@@ -207,11 +207,17 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(400, b"Bad Request",
                                (str(e) + "\n").encode()))
             return
-        fut = rdb.propose(query, group)
-        afut = self.srv.loop.create_future()
-        fut.add_done_callback(
-            lambda err: self.srv.bridge.deliver(afut, err))
+        # The whole propose+await runs under the broad handling _do_get
+        # uses: an unexpected exception (e.g. pipe/queue closed during
+        # node shutdown) would otherwise kill this task and leave the
+        # connection busy=True forever — the client hangs instead of
+        # seeing a 400 (the threaded plane's do_PUT catches everything).
+        fut = None
         try:
+            fut = rdb.propose(query, group)
+            afut = self.srv.loop.create_future()
+            fut.add_done_callback(
+                lambda err: self.srv.bridge.deliver(afut, err))
             err = await asyncio.wait_for(afut, self.srv.timeout_s)
         except asyncio.TimeoutError:
             # Deregister the ack so it cannot leak; the statement may
@@ -219,6 +225,16 @@ class _Conn(asyncio.Protocol):
             rdb.abandon(query, group, fut)
             self._finish(_resp(
                 400, b"Bad Request", b"proposal not committed in time\n"))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            if fut is not None:
+                try:
+                    rdb.abandon(query, group, fut)
+                except Exception:                   # noqa: BLE001
+                    pass
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
             return
         if err is not None:
             log.info("client error: %s", err)

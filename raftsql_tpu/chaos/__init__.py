@@ -1,0 +1,43 @@
+"""Deterministic chaos harness: seeded fault schedules, crash/partition/
+disk-fault injection, and invariant checking over the live engine.
+
+The reference's only fault story is test-driven node stop/restart
+(reference raftsql_test.go:47-52, 117-170).  This package is the
+systematic version, built on three seams the engine already exposes:
+
+  * the dense message plane (transport/faults.py masks) for seeded
+    drops, delays, and partitions — applied between device dispatches;
+  * the storage I/O seam (storage/fsio.py) for failed fsyncs, torn
+    writes, and unsynced-tail loss at a chosen operation count;
+  * hard process-crash simulation (open durable fds redirected to
+    /dev/null so buffered bytes can never be resurrected by a flush)
+    plus full restart-from-WAL, for both the fused single-dispatch
+    runtime and the threaded/lockstep RaftNode cluster.
+
+Every scenario is a tick-indexed `ChaosSchedule` derived from ONE seed;
+re-running a seed reproduces the identical schedule (digest-checked by
+`make chaos`).  After (and during) every scenario four invariants are
+enforced (chaos/invariants.py): committed-entry durability across
+crashes, at most one leader per term, log matching across survivors,
+and linearizability of the KV plane's completed PUT/GET history.
+"""
+from raftsql_tpu.chaos.invariants import (DurabilityLedger, ElectionSafety,
+                                          InvariantViolation,
+                                          RegisterLinearizability)
+from raftsql_tpu.chaos.schedule import (LEADER_TARGET, ChaosSchedule,
+                                        CrashEvent, DelayWindow, DropWindow,
+                                        FsyncFault, NodeChaosPlan, NodeCrash,
+                                        PartitionWindow, TornWriteFault,
+                                        generate, generate_node_plan)
+from raftsql_tpu.chaos.scenarios import (FusedChaosRunner,
+                                         NodeClusterChaosRunner)
+
+__all__ = [
+    "LEADER_TARGET", "ChaosSchedule", "CrashEvent", "DelayWindow",
+    "DropWindow", "FsyncFault", "NodeChaosPlan", "NodeCrash",
+    "PartitionWindow", "TornWriteFault", "generate",
+    "generate_node_plan",
+    "DurabilityLedger", "ElectionSafety", "InvariantViolation",
+    "RegisterLinearizability", "FusedChaosRunner",
+    "NodeClusterChaosRunner",
+]

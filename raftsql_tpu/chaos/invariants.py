@@ -1,0 +1,224 @@
+"""Invariant checkers the chaos runners enforce during and after runs.
+
+Four invariants (raft paper §5.4 + the durability contract of
+SURVEY.md §2d.8):
+
+  * ELECTION SAFETY — at most one leader per (group, term), across the
+    whole run including restarts.
+  * COMMIT MONOTONICITY — a peer's durably-observed commit index never
+    regresses, including across crash/restart (observations are taken
+    only after the tick's fsync barrier, so every observed value is
+    durable).
+  * LOG MATCHING — survivors agree entry-for-entry (term and payload)
+    on the overlap of their committed prefixes.
+  * DURABILITY — every entry ever published to the apply plane (i.e.
+    acked to a client) reappears, byte-identical, in the post-restart
+    replay.
+
+plus a single-register-per-key LINEARIZABILITY check over the KV
+plane's completed PUT/GET history.  Violations raise
+`InvariantViolation` (an AssertionError so pytest reports them as
+failures, not errors).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class ElectionSafety:
+    """At most one leader per (group, term) for the run's lifetime."""
+
+    def __init__(self, leader_code: int = 2):
+        self._leader_code = leader_code
+        self._leader_of_term: Dict[Tuple[int, int], int] = {}
+        self.observations = 0
+
+    def observe(self, tick: int, roles: np.ndarray,
+                terms: np.ndarray) -> None:
+        """roles/terms are [P, G] snapshots (one peer's row may be
+        masked with role -1 for a dead node)."""
+        self.observations += 1
+        lead_p, lead_g = np.nonzero(roles == self._leader_code)
+        for p, g in zip(lead_p.tolist(), lead_g.tolist()):
+            key = (g, int(terms[p, g]))
+            prev = self._leader_of_term.setdefault(key, p)
+            if prev != p:
+                raise InvariantViolation(
+                    f"t={tick} g={g}: two leaders ({prev}, {p}) "
+                    f"in term {key[1]}")
+
+
+class CommitMonotonic:
+    """Durably-observed commit indexes never regress."""
+
+    def __init__(self, peers: int, groups: int):
+        self._hi = np.zeros((peers, groups), np.int64)
+
+    def observe(self, tick: int, commits: np.ndarray) -> None:
+        if (commits < self._hi).any():
+            p, g = np.nonzero(commits < self._hi)
+            p, g = int(p[0]), int(g[0])
+            raise InvariantViolation(
+                f"t={tick} p={p} g={g}: commit regressed "
+                f"{self._hi[p, g]} -> {commits[p, g]}")
+        np.maximum(self._hi, commits, out=self._hi)
+
+
+def check_log_matching(tick: int, commits: np.ndarray, plogs) -> None:
+    """Survivors' committed prefixes agree entry-for-entry.
+
+    commits: [P, G] committed indexes; plogs: per-peer payload logs
+    (storage/log.py — `slice_columns(g, start, n) -> (terms, datas)`).
+    Compares every pair's overlap; scenarios here never compact, so the
+    full prefix is readable from index 1.
+    """
+    P, G = commits.shape
+    for g in range(G):
+        ref_p: Optional[int] = None
+        ref: Optional[Tuple[list, list]] = None
+        for p in range(P):
+            c = int(commits[p, g])
+            if c <= 0:
+                continue
+            terms, datas = plogs[p].slice_columns(g, 1, c)
+            if len(datas) != c:
+                raise InvariantViolation(
+                    f"t={tick} p={p} g={g}: payload log shorter than "
+                    f"commit ({len(datas)} < {c})")
+            if ref is None:
+                ref_p, ref = p, (list(terms), list(datas))
+                continue
+            n = min(c, len(ref[1]))
+            if list(terms[:n]) != ref[0][:n] \
+                    or list(datas[:n]) != ref[1][:n]:
+                raise InvariantViolation(
+                    f"t={tick} g={g}: committed prefixes diverge "
+                    f"between p{ref_p} and p{p}")
+            if c > len(ref[1]):
+                ref_p, ref = p, (list(terms), list(datas))
+
+
+class DurabilityLedger:
+    """Every published (client-visible) entry must survive restart."""
+
+    def __init__(self):
+        self._committed: Dict[Tuple[int, int], bytes] = {}
+
+    def record(self, group: int, index: int, payload: bytes) -> None:
+        prev = self._committed.setdefault((group, index), payload)
+        if prev != payload:
+            raise InvariantViolation(
+                f"g{group} i{index}: committed entry changed content "
+                f"({prev!r} -> {payload!r})")
+
+    def __len__(self) -> int:
+        return len(self._committed)
+
+    def verify_replay(self, replayed: Dict[Tuple[int, int], bytes],
+                      context: str = "") -> None:
+        """`replayed` maps (group, index) -> payload from the restart's
+        replay stream; it must be a superset of everything recorded."""
+        for (g, i), payload in self._committed.items():
+            got = replayed.get((g, i))
+            if got is None:
+                raise InvariantViolation(
+                    f"{context}: committed entry g{g} i{i} "
+                    f"({payload!r}) lost across restart")
+            if got != payload:
+                raise InvariantViolation(
+                    f"{context}: committed entry g{g} i{i} changed "
+                    f"across restart ({payload!r} -> {got!r})")
+
+
+class RegisterLinearizability:
+    """Per-key register linearizability over completed PUT/GET history.
+
+    PUT values are globally unique (the runners guarantee it), so a
+    read names exactly the write it observed and the real-time
+    precedence check is direct — no state-space search:
+
+      a GET returning write w's value is legal iff
+        * w was invoked before the GET's response (no reading the
+          future), and
+        * w does not STRICTLY PRECEDE (w.resp <= w2.inv) any write w2
+          on the key that completed before the GET was invoked — such
+          a w2 must linearize after w and before the GET, making w
+          stale.
+
+    The initial value "" is legal only while no write on the key has
+    completed before the GET's invocation.  Incomplete writes (e.g.
+    proposals lost in a crash, which may still commit after a restart)
+    may linearize anywhere after their invocation or never — exactly
+    the window these rules grant.  Overlapping writes to one key may
+    legally complete in either order (leader failover reorders
+    re-routed proposal queues), which is why precedence, not issue
+    order, is the test.  This is the standard necessary-condition
+    per-op check (cf. Jepsen's register checkers); it does not search
+    for a single total order across reads.
+    """
+
+    def __init__(self):
+        self._clock = 0
+        self._writes: Dict[str, list] = {}   # value -> [key, inv, resp]
+        # key -> [(inv, resp), ...] of COMPLETED writes.
+        self._completed: Dict[str, List[Tuple[int, int]]] = {}
+        self.reads_checked = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # -- write lifecycle -----------------------------------------------
+
+    def begin_write(self, key: str, value: str) -> None:
+        if value in self._writes:
+            raise ValueError(f"write values must be unique: {value!r}")
+        self._writes[value] = [key, self._tick(), None]
+
+    def end_write(self, value: str) -> None:
+        w = self._writes.get(value)
+        if w is None or w[2] is not None:
+            return                       # unknown or already completed
+        w[2] = self._tick()
+        self._completed.setdefault(w[0], []).append((w[1], w[2]))
+
+    # -- read lifecycle ------------------------------------------------
+
+    def begin_read(self, key: str) -> Tuple[str, int]:
+        return key, self._tick()
+
+    def end_read(self, handle: Tuple[str, int], value: str) -> None:
+        key, inv = handle
+        resp = self._tick()
+        self.reads_checked += 1
+        completed = self._completed.get(key, ())
+        if value == "":
+            for (i2, r2) in completed:
+                if r2 <= inv:
+                    raise InvariantViolation(
+                        f"read({key!r}) returned the initial value "
+                        f"after a write completed before it")
+            return
+        w = self._writes.get(value)
+        if w is None or w[0] != key:
+            raise InvariantViolation(
+                f"read({key!r}) returned a value never written to "
+                f"that key: {value!r}")
+        _, w_inv, w_resp = w
+        if w_inv > resp:
+            raise InvariantViolation(
+                f"read({key!r}) returned {value!r} invoked after the "
+                f"read's response")
+        if w_resp is not None:
+            for (i2, r2) in completed:
+                if r2 <= inv and w_resp <= i2:
+                    raise InvariantViolation(
+                        f"read({key!r}) returned stale value "
+                        f"{value!r}: a later write completed before "
+                        f"the read began")

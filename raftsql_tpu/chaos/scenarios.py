@@ -1,0 +1,568 @@
+"""Chaos scenario runners: drive a live engine through a seeded
+`ChaosSchedule`, injecting faults at every seam, checking invariants
+every tick.
+
+Two runners, matching the two deployment shapes:
+
+  * `FusedChaosRunner` — the fused single-dispatch runtime
+    (runtime/fused.py FusedClusterNode).  Fully deterministic: one
+    thread drives `tick()` manually, fault masks are host-generated
+    from the schedule's seed, crashes are simulated in-process, and
+    the run's result digest is reproducible bit-for-bit from the seed
+    (`make chaos` proves it by running a seed twice).
+  * `NodeClusterChaosRunner` — the threaded/distributed runtime
+    (runtime/node.py RaftNode) as a LOCKSTEP cluster over the loopback
+    transport: per-node crash/restart, leader-targeted kills, and
+    FaultPlan partitions, with per-node durability and cross-node log
+    matching checked from the commit streams.
+
+Crash simulation ("hard crash"): every open durable fd of the dying
+node is redirected to /dev/null before the object is abandoned — a
+buffered-but-unflushed byte can then never be resurrected by a later
+GC flush into the file the restarted node is appending to.  That IS a
+process kill's semantics (userspace buffers lost, flushed page-cache
+bytes kept).  A POWER LOSS additionally truncates every file to its
+last really-fsynced size, optionally tearing one peer's last record
+mid-write (storage/fsio.py records both) — which is exactly the state
+WAL._repair_tail and the epoch-repair path exist to recover.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raftsql_tpu.chaos.invariants import (CommitMonotonic,
+                                          DurabilityLedger, ElectionSafety,
+                                          InvariantViolation,
+                                          RegisterLinearizability,
+                                          check_log_matching)
+from raftsql_tpu.chaos.schedule import (LEADER_TARGET, ChaosSchedule,
+                                        NodeChaosPlan)
+from raftsql_tpu.config import LEADER, RaftConfig
+from raftsql_tpu.runtime.db import _expand_commit_item, iter_plain_batches
+from raftsql_tpu.runtime.fused import FusedClusterNode
+from raftsql_tpu.runtime.node import CLOSED, RaftNode
+from raftsql_tpu.storage import fsio
+from raftsql_tpu.transport.faults import (drop_messages, hold_messages,
+                                          partition_peer, release_messages)
+from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+
+DEAD_ROLE = -1          # role code for a crashed node's safety-matrix row
+
+
+def _redirect_to_devnull(files) -> None:
+    """dup2 /dev/null over every open fd so abandoned buffered writers
+    can never flush real bytes later."""
+    devnull = os.open(os.devnull, os.O_WRONLY)
+    try:
+        for f in files:
+            if f is not None and not f.closed:
+                os.dup2(devnull, f.fileno())
+    finally:
+        os.close(devnull)
+
+
+def hard_crash_fused(node: FusedClusterNode) -> None:
+    """Simulate a process kill of the whole fused cluster process.
+
+    Requires the Python WAL backend (an installed fsio injector forces
+    it): the native backend buffers inside C++ where this simulation
+    cannot reach."""
+    _redirect_to_devnull([getattr(w, "_f", None) for w in node.wals]
+                         + [node._epoch_f])
+    # Unblock the publisher worker so the abandoned daemon thread exits
+    # instead of leaking one thread per simulated crash.
+    try:
+        node._pub_q.put_nowait(None)
+    except queue.Full:                   # pragma: no cover - bounded lag
+        pass
+
+
+def hard_crash_node(node: RaftNode) -> None:
+    """Simulate a process kill of one RaftNode: WAL fd neutered, then
+    detached from the loopback hub (its 'NIC' goes dark)."""
+    _redirect_to_devnull([getattr(node.wal, "_f", None)])
+    node.transport.stop()
+
+
+def _power_loss(inj: fsio.StorageFaultInjector, data_dir: str,
+                tear_peer: int = -1) -> Tuple[int, int]:
+    """Apply power-loss semantics to every tracked file under data_dir:
+    drop everything after the last real fsync, tearing (keeping a
+    partial prefix of) the tear peer's last unsynced record instead of
+    dropping it whole.  Returns (files_truncated, records_torn)."""
+    torn = dropped = 0
+    tear_paths = set()
+    if tear_peer >= 0:
+        tag = os.sep + f"p{tear_peer + 1}" + os.sep
+        for path in inj.tracked_paths():
+            if path.startswith(data_dir) and tag in path \
+                    and inj.tear_last_write(path):
+                torn += 1
+                tear_paths.add(path)
+    for path in inj.tracked_paths():
+        if path.startswith(data_dir) and path not in tear_paths \
+                and inj.drop_unsynced(path):
+            dropped += 1
+    return dropped, torn
+
+
+def _drain_fused_q(q: "queue.Queue") -> List[Tuple[int, int, List[bytes]]]:
+    """Drain a fused commit queue non-blocking into plain
+    (group, base_idx, [payload, ...]) batches (sentinels skipped)."""
+    batches: List[Tuple[int, int, List[bytes]]] = []
+    while True:
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            return batches
+        if item is None:
+            continue
+        if item is CLOSED:
+            return batches
+        batches.extend(iter_plain_batches(item))
+
+
+class FusedChaosRunner:
+    """Drive a FusedClusterNode through a ChaosSchedule.
+
+    Workload: seeded unique-value PUTs (`SET k<K> v<seq>`) routed by
+    key to a group, plus linearizable GETs registered through
+    `read_index` and resolved against peer 0's applied state.  Every
+    tick: release due delayed messages, apply the tick's fault masks,
+    issue workload, dispatch, flush+drain publishes, resolve reads,
+    observe invariants.  Crashes (scheduled, or triggered by an
+    injected fsync failure) restart the cluster from its WALs and
+    verify the durability ledger against the replay.
+    """
+
+    KEYS = 8
+    LOG_MATCH_EVERY = 16
+
+    def __init__(self, schedule: ChaosSchedule, data_dir: str,
+                 cfg: Optional[RaftConfig] = None, steps: int = 1):
+        self.sched = schedule
+        self.data_dir = data_dir
+        self.cfg = cfg or RaftConfig(
+            num_groups=4, num_peers=schedule_peers(schedule),
+            log_window=64, max_entries_per_msg=4, election_ticks=10,
+            heartbeat_ticks=1, tick_interval_s=0.0)
+        self.steps = steps
+        self.node: Optional[FusedClusterNode] = None
+        self.ledger = DurabilityLedger()
+        self.lin = RegisterLinearizability()
+        self.safety = ElectionSafety(LEADER)
+        self.monotonic = CommitMonotonic(self.cfg.num_peers,
+                                         self.cfg.num_groups)
+        self._kv: Dict[str, str] = {}
+        self._applied = np.zeros(self.cfg.num_groups, np.int64)
+        self._held: List[Tuple[int, object]] = []
+        self._pending_reads: List[Tuple[str, int, int, tuple]] = []
+        self._part_peer: Dict[int, int] = {}
+        self._wseq = 0
+        self.report: Dict[str, int] = {
+            "crashes": 0, "restarts": 0, "partitions": 0,
+            "fsync_faults": 0, "torn_write_faults": 0, "torn_writes": 0,
+            "unsynced_files_dropped": 0, "dropped_slots": 0,
+            "delayed_slots": 0, "log_match_checks": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _boot(self, first: bool) -> FusedClusterNode:
+        node = FusedClusterNode(self.cfg, self.data_dir,
+                                seed=self.sched.seed)
+        if self.steps > 1:
+            node._steps = self.steps
+        node.publish_peers = {0}
+        replayed: Dict[Tuple[int, int], bytes] = {}
+        order: List[Tuple[int, int, bytes]] = []
+        for p in range(self.cfg.num_peers):
+            for (g, base, datas) in _drain_fused_q(node.commit_q(p)):
+                if p != 0:
+                    continue             # peer 0's stream is the client
+                for off, d in enumerate(datas):
+                    if d:
+                        replayed[(g, base + 1 + off)] = d
+                        order.append((g, base + 1 + off, d))
+        if not first:
+            self.ledger.verify_replay(
+                replayed, context=f"restart {self.report['restarts']}")
+            self.report["restarts"] += 1
+        # Rebuild the client-visible KV state from the replay (per-group
+        # index order; groups are independent key spaces).
+        self._kv.clear()
+        for g, i, d in sorted(order):
+            self._apply(g, i, d)
+        self._applied = node._applied[0].copy()
+        node.metrics.faults_crashes = self.report["crashes"]
+        return node
+
+    def _crash_restart(self, tick: int, power_loss: bool = False,
+                       tear_peer: int = -1) -> None:
+        hard_crash_fused(self.node)
+        self.report["crashes"] += 1
+        if power_loss:
+            inj = fsio.injector()
+            dropped, torn = _power_loss(inj, self.data_dir, tear_peer)
+            self.report["unsynced_files_dropped"] += dropped
+            self.report["torn_writes"] += torn
+        # In-flight state dies with the process: delayed messages and
+        # registered-but-unresolved reads (their clients aborted).
+        self._held.clear()
+        self._pending_reads.clear()
+        self.node = self._boot(first=False)
+
+    # -- workload ------------------------------------------------------
+
+    def _apply(self, g: int, idx: int, payload: bytes) -> None:
+        self.ledger.record(g, idx, payload)
+        parts = payload.decode("utf-8").split(" ")
+        if len(parts) == 3 and parts[0] == "SET":
+            self._kv[parts[1]] = parts[2]
+            self.lin.end_write(parts[2])
+        self._applied[g] = max(self._applied[g], idx)
+
+    def _issue(self, rng: np.random.Generator) -> None:
+        if rng.random() < self.sched.prop_rate:
+            k = int(rng.integers(0, self.KEYS))
+            g = k % self.cfg.num_groups
+            value = f"v{self._wseq}"
+            self._wseq += 1
+            self.lin.begin_write(f"k{k}", value)
+            self.node.propose_many(g, [f"SET k{k} {value}".encode()])
+        if rng.random() < self.sched.read_rate:
+            k = int(rng.integers(0, self.KEYS))
+            g = k % self.cfg.num_groups
+            got = self.node.read_index(g)
+            if got:                       # leaderless: client retries later
+                target, _ = got
+                self._pending_reads.append(
+                    (f"k{k}", g, target, self.lin.begin_read(f"k{k}")))
+
+    def _resolve_reads(self) -> None:
+        still = []
+        for (key, g, target, handle) in self._pending_reads:
+            if self._applied[g] >= target:
+                self.lin.end_read(handle, self._kv.get(key, ""))
+            else:
+                still.append((key, g, target, handle))
+        self._pending_reads = still
+
+    # -- fault application ---------------------------------------------
+
+    def _apply_faults(self, t: int, rng: np.random.Generator) -> None:
+        node = self.node
+        due = [h for (rt, h) in self._held if rt <= t]
+        self._held = [(rt, h) for (rt, h) in self._held if rt > t]
+        for h in due:                    # released mail is subject to
+            node.inboxes = release_messages(node.inboxes, h)  # this
+        shape = node.inboxes.v_type.shape          # tick's masks below
+        for w in self.sched.delays:
+            if w.start <= t < w.end:
+                mask = rng.random(shape) < w.p
+                if mask.any():
+                    delivered, held = hold_messages(node.inboxes,
+                                                    jnp.asarray(mask))
+                    node.inboxes = delivered
+                    self._held.append((t + w.latency, held))
+                    self.report["delayed_slots"] += int(mask.sum())
+        for w in self.sched.drops:
+            if w.start <= t < w.end:
+                mask = rng.random(shape) < w.p
+                if mask.any():
+                    node.inboxes = drop_messages(node.inboxes,
+                                                 jnp.asarray(mask))
+                    self.report["dropped_slots"] += int(mask.sum())
+        for wi, w in enumerate(self.sched.partitions):
+            if w.start <= t < w.end:
+                peer = self._part_peer.get(wi)
+                if peer is None:
+                    peer = w.peer if w.peer >= 0 \
+                        else max(self.node.leader_of(0), 0)
+                    self._part_peer[wi] = peer
+                    self.report["partitions"] += 1
+                node.inboxes = partition_peer(node.inboxes, peer)
+
+    # -- invariants ----------------------------------------------------
+
+    def _observe(self, t: int) -> None:
+        node = self.node
+        roles = node.roles()
+        terms = np.asarray(node.states.term)
+        self.safety.observe(t, roles, terms)
+        commits = node._hard[:, :, 2]
+        self.monotonic.observe(t, commits)
+        if t % self.LOG_MATCH_EVERY == 0:
+            check_log_matching(t, commits, node.plogs)
+            self.report["log_match_checks"] += 1
+
+    # -- the run -------------------------------------------------------
+
+    def run(self) -> dict:
+        inj = fsio.StorageFaultInjector()
+        for f in self.sched.fsync_faults:
+            inj.add_rule(os.sep + f"p{f.peer + 1}" + os.sep,
+                         fail_at=(f.op,))
+        for f in self.sched.torn_writes:
+            inj.add_rule(os.sep + f"p{f.peer + 1}" + os.sep,
+                         crash_write_at=(f.op,), tag=f.peer)
+        crash_at = {ev.tick: ev for ev in self.sched.crashes}
+        rng = np.random.default_rng(self.sched.seed + 1)
+        with fsio.installed(inj):
+            self.node = self._boot(first=True)
+            try:
+                for t in range(self.sched.ticks):
+                    ev = crash_at.get(t)
+                    if ev is not None:
+                        self._crash_restart(t, ev.power_loss,
+                                            ev.tear_peer)
+                    self._apply_faults(t, rng)
+                    self._issue(rng)
+                    try:
+                        self.node.tick()
+                    except fsio.FsyncFaultError:
+                        # etcd posture: a failed WAL fsync is fatal —
+                        # crash the process rather than ack unsynced
+                        # data; the restart replays the durable prefix.
+                        self.report["fsync_faults"] += 1
+                        self._crash_restart(t, power_loss=False)
+                        continue
+                    except fsio.CrashPointError as e:
+                        # Power loss mid-record: the machine dies with
+                        # the record partially written and the tick's
+                        # barrier never reached — tear that record,
+                        # drop every unsynced tail, restart.
+                        self.report["torn_write_faults"] += 1
+                        self._crash_restart(t, power_loss=True,
+                                            tear_peer=int(e.tag))
+                        continue
+                    self.node.publish_flush()
+                    for (g, base, datas) in _drain_fused_q(
+                            self.node.commit_q(0)):
+                        for off, d in enumerate(datas):
+                            if d:
+                                self._apply(g, base + 1 + off, d)
+                    self._applied = np.maximum(self._applied,
+                                               self.node._applied[0])
+                    self._resolve_reads()
+                    self._observe(t)
+                # Final deep checks + a restart pass so the run always
+                # ends with a full durability audit.
+                check_log_matching(self.sched.ticks,
+                                   self.node._hard[:, :, 2],
+                                   self.node.plogs)
+                self.report["log_match_checks"] += 1
+                self._crash_restart(self.sched.ticks)
+                m = self.node.metrics
+                m.faults_dropped_msgs = self.report["dropped_slots"]
+                m.faults_delayed_msgs = self.report["delayed_slots"]
+                m.faults_partitions = self.report["partitions"]
+                m.faults_fsync = self.report["fsync_faults"]
+            finally:
+                node, self.node = self.node, None
+                if node is not None:
+                    node.stop()
+        return self._report()
+
+    def _report(self) -> dict:
+        committed = sorted(
+            (g, i, d.decode("utf-8"))
+            for (g, i), d in self.ledger._committed.items())
+        blob = json.dumps(
+            {"committed": committed, "report": self.report,
+             "writes": self._wseq, "reads": self.lin.reads_checked},
+            sort_keys=True, separators=(",", ":")).encode()
+        return {
+            "seed": self.sched.seed,
+            "ticks": self.sched.ticks,
+            "schedule_digest": self.sched.digest(),
+            "result_digest": hashlib.sha256(blob).hexdigest()[:16],
+            "committed_entries": len(self.ledger),
+            "writes_issued": self._wseq,
+            "reads_checked": self.lin.reads_checked,
+            "safety_observations": self.safety.observations,
+            **self.report,
+        }
+
+
+def schedule_peers(schedule: ChaosSchedule) -> int:
+    """Peer count implied by a schedule's targets (min 3)."""
+    peers = 3
+    for w in schedule.partitions:
+        peers = max(peers, w.peer + 1)
+    for ev in schedule.crashes:
+        peers = max(peers, ev.tear_peer + 1)
+    for f in schedule.fsync_faults:
+        peers = max(peers, f.peer + 1)
+    return peers
+
+
+class NodeClusterChaosRunner:
+    """Lockstep RaftNode cluster under a NodeChaosPlan.
+
+    P RaftNodes over the loopback transport, ticked manually in id
+    order (deterministic consensus schedule; envelope ids randomize WAL
+    bytes but not the schedule).  Faults: FaultPlan partitions,
+    per-node hard crash + restart-from-WAL, leader-targeted kills.
+    Invariants: election safety, per-node commit-stream durability
+    across restart, and cross-node log matching of live-published
+    (committed) entries.
+    """
+
+    def __init__(self, plan: NodeChaosPlan, tmpdir: str,
+                 cfg: Optional[RaftConfig] = None, peers: int = 3):
+        self.plan = plan
+        self.tmpdir = tmpdir
+        self.P = peers
+        self.cfg = cfg or RaftConfig(
+            num_groups=2, num_peers=peers, log_window=64,
+            max_entries_per_msg=4, election_ticks=10, heartbeat_ticks=1,
+            tick_interval_s=0.0)
+        self.hub = LoopbackHub()
+        self.nodes: List[Optional[RaftNode]] = [None] * peers
+        self.safety = ElectionSafety(LEADER)
+        self.monotonic = CommitMonotonic(peers, self.cfg.num_groups)
+        # Live-published (committed) history, shared: (g, idx) -> sql.
+        self._hist: Dict[Tuple[int, int], str] = {}
+        # Per node: everything IT has published live (must survive its
+        # own restarts).
+        self._published: List[Dict[Tuple[int, int], str]] = [
+            {} for _ in range(peers)]
+        self.report = {"crashes": 0, "restarts": 0, "partitions": 0,
+                       "commits": 0}
+
+    def _data_dir(self, p: int) -> str:
+        return os.path.join(self.tmpdir, f"chaos-node-{p + 1}")
+
+    def _boot(self, p: int) -> RaftNode:
+        n = RaftNode(p + 1, self.P, self.cfg,
+                     LoopbackTransport(self.hub), self._data_dir(p))
+        n.start(threaded=False)
+        # Replay drain: every WAL entry then the nil sentinel
+        # (raft.go:122-134).  Verify durability of everything this node
+        # ever acked; do NOT fold replay into the shared history —
+        # replay includes uncommitted entries that may legally be
+        # conflict-truncated later.
+        replayed: Dict[Tuple[int, int], str] = {}
+        while True:
+            try:
+                item = n.commit_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                break
+            if item is CLOSED:
+                break
+            for (g, idx, sql) in _expand_commit_item(item, n):
+                replayed[(g, idx)] = sql
+        for (g, idx), sql in self._published[p].items():
+            got = replayed.get((g, idx))
+            if got != sql:
+                raise InvariantViolation(
+                    f"node {p}: committed entry g{g} i{idx} "
+                    f"{'lost' if got is None else 'changed'} across "
+                    f"restart")
+        return n
+
+    def _resolve(self, peer: int) -> int:
+        if peer != LEADER_TARGET:
+            return peer
+        for n in self.nodes:
+            if n is not None and n.leader_of(0) >= 0:
+                return int(n.leader_of(0))
+        return 0
+
+    def _drain_live(self) -> None:
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            while True:
+                try:
+                    item = n.commit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None or item is CLOSED:
+                    continue
+                for (g, idx, sql) in _expand_commit_item(item, n):
+                    prev = self._hist.setdefault((g, idx), sql)
+                    if prev != sql:
+                        raise InvariantViolation(
+                            f"log matching: node {p} committed g{g} "
+                            f"i{idx} {sql!r} but {prev!r} was committed")
+                    self._published[p][(g, idx)] = sql
+                    self.report["commits"] += 1
+
+    def _observe(self, t: int) -> None:
+        G = self.cfg.num_groups
+        roles = np.full((self.P, G), DEAD_ROLE, np.int64)
+        terms = np.zeros((self.P, G), np.int64)
+        commits = np.zeros((self.P, G), np.int64)
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            roles[p] = n._last_role
+            terms[p] = n._hard_np[:, 0]
+            commits[p] = n._hard_np[:, 2]
+        self.safety.observe(t, roles, terms)
+        # Dead rows read 0 — mask them to each node's running floor so
+        # a down node never looks like a regression.
+        commits = np.maximum(commits, self.monotonic._hi * (roles < 0))
+        self.monotonic.observe(t, commits)
+
+    def run(self) -> dict:
+        inj = fsio.StorageFaultInjector()   # no rules: forces the
+        rng = np.random.default_rng(self.plan.seed + 1)  # python WAL
+        crash_at: Dict[int, list] = {}
+        for c in self.plan.crashes:
+            crash_at.setdefault(c.tick, []).append(c)
+        down_until: Dict[int, int] = {}
+        with fsio.installed(inj):
+            for p in range(self.P):
+                self.nodes[p] = self._boot(p)
+            try:
+                for t in range(self.plan.ticks):
+                    for c in crash_at.get(t, ()):
+                        p = self._resolve(c.peer)
+                        if self.nodes[p] is None:
+                            continue
+                        hard_crash_node(self.nodes[p])
+                        self.nodes[p] = None
+                        down_until[p] = t + c.down
+                        self.report["crashes"] += 1
+                    for p in [p for p, d in down_until.items()
+                              if d <= t]:
+                        del down_until[p]
+                        self.nodes[p] = self._boot(p)
+                        self.report["restarts"] += 1
+                    self.hub.faults.heal()
+                    for w in self.plan.partitions:
+                        if w.start <= t < w.end:
+                            if t == w.start:
+                                self.report["partitions"] += 1
+                            self.hub.faults.isolate(
+                                w.peer + 1, range(1, self.P + 1))
+                    if rng.random() < self.plan.prop_rate:
+                        alive = [p for p, n in enumerate(self.nodes)
+                                 if n is not None]
+                        src = alive[int(rng.integers(0, len(alive)))]
+                        g = int(rng.integers(0, self.cfg.num_groups))
+                        self.nodes[src].propose(
+                            g, f"SET k{g} v{t}".encode())
+                    for n in self.nodes:
+                        if n is not None:
+                            n.tick()
+                    self._drain_live()
+                    self._observe(t)
+            finally:
+                for n in self.nodes:
+                    if n is not None:
+                        n.stop()
+        return {"plan_digest": self.plan.digest(), **self.report}

@@ -1,0 +1,220 @@
+"""Seeded, deterministic, tick-indexed fault schedules.
+
+A schedule is a frozen dataclass: every fault the scenario will inject
+— message-drop windows, delay windows, peer partitions, crash/restart
+events, storage fsync faults — pinned to tick indexes before the run
+starts.  `generate(seed)` derives one from a single integer seed via
+`numpy.random.default_rng`, so any failure reproduces from its seed
+alone; `digest()` hashes the canonical form so `make chaos` can prove
+two runs of one seed saw the identical schedule.
+
+"Paxos vs Raft" (arXiv:2004.05074) argues raft's safety claims only
+mean something under adversarial schedules of partitions and crashes;
+this module is where those schedules come from.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Tuple
+
+import numpy as np
+
+# Partition / crash target sentinel: resolved at the window's first tick
+# to whichever peer then leads group 0 — the leader-targeted kill.
+LEADER_TARGET = -2
+
+
+@dataclasses.dataclass(frozen=True)
+class DropWindow:
+    """Drop each message slot independently with probability p while
+    start <= tick < end (transport.faults.random_drop)."""
+    start: int
+    end: int
+    p: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DelayWindow:
+    """Hold each message slot with probability p for `latency` ticks
+    before delivery (transport.faults.hold_messages/release_messages).
+    Messages still in flight at a crash are lost — as on a real wire."""
+    start: int
+    end: int
+    p: float
+    latency: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionWindow:
+    """Isolate one peer (nothing in, nothing out) for the window.
+    peer == LEADER_TARGET resolves to group 0's leader at `start`."""
+    start: int
+    end: int
+    peer: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashEvent:
+    """Hard process crash at `tick` (the whole fused cluster process),
+    followed by immediate restart-from-WAL.  power_loss=True models a
+    machine crash instead: everything not fsynced is dropped, and
+    `tear_peer` (if >= 0) additionally has its last WAL write torn
+    mid-record.  Scheduled crashes fire on tick boundaries (post-
+    barrier); MID-tick power loss comes from TornWriteFault."""
+    tick: int
+    power_loss: bool = False
+    tear_peer: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class FsyncFault:
+    """The op-th fsync under peer `peer`'s WAL directory raises (a
+    failed disk flush).  The runner treats it as fatal for the process
+    — crash + restart — which is the etcd posture (panic on WAL sync
+    failure rather than ack unsynced data)."""
+    peer: int
+    op: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TornWriteFault:
+    """Power loss mid-way through peer `peer`'s op-th WAL record write:
+    the machine dies with the record partially in the page cache and
+    nothing of the current tick fsynced.  The runner tears that record
+    (truncates it mid-write), drops every other file's unsynced tail,
+    and restarts — WAL._repair_tail and epoch repair must recover."""
+    peer: int
+    op: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    """A complete scripted scenario for the fused runtime."""
+    seed: int
+    ticks: int
+    drops: Tuple[DropWindow, ...] = ()
+    delays: Tuple[DelayWindow, ...] = ()
+    partitions: Tuple[PartitionWindow, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    fsync_faults: Tuple[FsyncFault, ...] = ()
+    torn_writes: Tuple[TornWriteFault, ...] = ()
+    prop_rate: float = 0.5       # P(issue a PUT batch) per tick
+    read_rate: float = 0.35      # P(issue a linearizable GET) per tick
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.describe(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeCrash:
+    """Threaded-cluster plane: kill peer `peer` (0-based, or
+    LEADER_TARGET) at `tick`, restart it `down` ticks later."""
+    tick: int
+    peer: int
+    down: int = 30
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeChaosPlan:
+    """Scripted scenario for the lockstep RaftNode cluster."""
+    seed: int
+    ticks: int
+    partitions: Tuple[PartitionWindow, ...] = ()
+    crashes: Tuple[NodeCrash, ...] = ()
+    prop_rate: float = 0.4
+
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def generate(seed: int, ticks: int = 240, peers: int = 3,
+             min_partitions: int = 2, min_crashes: int = 2,
+             min_fsync_faults: int = 1,
+             min_torn_writes: int = 1,
+             with_delays: bool = True) -> ChaosSchedule:
+    """Derive a full scenario from one seed.
+
+    Guarantees the floors the acceptance gate needs: >= min_partitions
+    partition windows (at least one leader-targeted), >= min_crashes
+    crash/restart events, >= min_fsync_faults injected fsync failures,
+    and >= min_torn_writes mid-write power losses (each also a
+    crash/restart).
+    """
+    rng = np.random.default_rng(seed)
+    warmup = 40                          # let first elections settle
+
+    n_part = int(min_partitions + rng.integers(0, 2))
+    parts = []
+    for i in range(n_part):
+        start = int(rng.integers(warmup, max(warmup + 1,
+                                             ticks - 60)))
+        length = int(rng.integers(20, 41))
+        # First window is always the leader-targeted kill.
+        peer = LEADER_TARGET if i == 0 else int(rng.integers(0, peers))
+        parts.append(PartitionWindow(start, min(start + length, ticks),
+                                     peer))
+    parts.sort(key=lambda w: w.start)
+
+    n_crash = int(min_crashes + rng.integers(0, 2))
+    lo, hi = int(ticks * 0.35), int(ticks * 0.9)
+    crash_ticks = sorted(int(t) for t in rng.choice(
+        np.arange(lo, hi), size=n_crash, replace=False))
+    # Scheduled crashes land on tick boundaries, where the durable
+    # barrier has just completed — they exercise clean process-kill
+    # replay.  Power-loss recovery (unsynced/torn tails) is exercised
+    # by the torn-write faults below, which fire MID-tick.
+    crashes = tuple(CrashEvent(t) for t in crash_ticks)
+
+    # Each active tick fsyncs every peer once, so op counts in the low
+    # tens always fire well before the first crash window.
+    faults = tuple(FsyncFault(int(rng.integers(0, peers)),
+                              int(rng.integers(15, 30)) + 10 * i)
+                   for i in range(min_fsync_faults))
+    # Every active tick writes at least a hard-state record per peer;
+    # write ops accumulate a few per active tick, so these fire mid-run.
+    torn = tuple(TornWriteFault(int(rng.integers(0, peers)),
+                                int(rng.integers(60, 120)) + 40 * i)
+                 for i in range(min_torn_writes))
+
+    drops = (DropWindow(int(rng.integers(warmup, ticks // 2)),
+                        int(rng.integers(ticks // 2, ticks)),
+                        float(rng.uniform(0.05, 0.2))),)
+    delays = ()
+    if with_delays:
+        d0 = int(rng.integers(warmup, ticks - 40))
+        delays = (DelayWindow(d0, d0 + int(rng.integers(20, 40)),
+                              float(rng.uniform(0.1, 0.3)),
+                              int(rng.integers(2, 5))),)
+
+    return ChaosSchedule(seed=seed, ticks=ticks, drops=drops,
+                         delays=delays, partitions=tuple(parts),
+                         crashes=crashes, fsync_faults=faults,
+                         torn_writes=torn)
+
+
+def generate_node_plan(seed: int, ticks: int = 320,
+                       peers: int = 3) -> NodeChaosPlan:
+    """Threaded-cluster plan: one leader-targeted kill, one follower
+    kill, one partition window — the reference's stop/restart scenarios
+    (raftsql_test.go:117-170) as a seeded schedule."""
+    rng = np.random.default_rng(seed)
+    warmup = 50
+    p0 = int(rng.integers(warmup, ticks // 3))
+    parts = (PartitionWindow(p0, p0 + int(rng.integers(25, 45)),
+                             int(rng.integers(0, peers))),)
+    c0 = int(rng.integers(ticks // 3, ticks // 2))
+    c1 = int(rng.integers(ticks // 2 + 20, int(ticks * 0.8)))
+    crashes = (NodeCrash(c0, LEADER_TARGET, down=int(rng.integers(25, 40))),
+               NodeCrash(c1, int(rng.integers(0, peers)),
+                         down=int(rng.integers(25, 40))))
+    return NodeChaosPlan(seed=seed, ticks=ticks, partitions=parts,
+                         crashes=crashes)

@@ -60,6 +60,7 @@ from raftsql_tpu.core.state import restore_peer_state
 from raftsql_tpu.core.step import INFO_FIELDS
 from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
 from raftsql_tpu.native.build import load_native_plog
+from raftsql_tpu.storage import fsio
 from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
 from raftsql_tpu.storage.wal import (WAL, split_uniform_runs,
                                       wal_exists, wal_mirror_all)
@@ -245,10 +246,17 @@ class FusedClusterNode:
         self._ep_active = False
         self._ep_begun = [False] * P
         self._ep_no_this: Optional[int] = None
-        if os.path.exists(self._epoch_path):
-            for d in self.dirs:
-                if wal_exists(d):
-                    WAL.repair_epochs(d, self._epoch_no)
+        # Repair runs whenever any peer WAL exists — even when EPOCHS is
+        # missing (committed epoch 0): EPOCHS is created lazily by the
+        # FIRST _commit_epoch, so a crash mid-barrier during the
+        # first-ever multi-step dispatch leaves epoch-1 BEGIN-framed
+        # records durable on some peers with no EPOCHS file at all, and
+        # skipping repair would replay exactly the non-atomic dispatch
+        # (e.g. a durable vote grant whose sender's term bump was lost)
+        # this mechanism exists to drop.
+        for d in self.dirs:
+            if wal_exists(d):
+                WAL.repair_epochs(d, self._epoch_no)
 
         states = []
         for p in range(P):
@@ -484,28 +492,32 @@ class FusedClusterNode:
         dispatch whose number never made it here."""
         import struct
         import zlib
+        created = False
         if self._epoch_f is None:
+            created = not os.path.exists(self._epoch_path)
             self._epoch_f = open(self._epoch_path, "ab")
         rec = struct.pack("<Q", no)
-        self._epoch_f.write(rec + struct.pack("<I", zlib.crc32(rec)))
-        self._epoch_f.flush()
-        os.fsync(self._epoch_f.fileno())
+        fsio.write(self._epoch_f,
+                   rec + struct.pack("<I", zlib.crc32(rec)))
+        fsio.fsync_file(self._epoch_f)
+        if created:
+            # Dirent durability for the just-created file, BEFORE the
+            # epoch counts as committed: the record fsync above makes
+            # the bytes durable but not the directory entry — a crash
+            # could drop the whole file, and recovery would then
+            # misclassify committed (already published/acked)
+            # dispatches as uncommitted.  Mirrors the rotation path.
+            fsio.fsync_dir(os.path.dirname(self._epoch_path) or ".")
         if self._epoch_f.tell() >= self._EPOCH_ROTATE_BYTES:
             # Rotate: only the LAST record matters for recovery.  Write
             # a one-record replacement beside the live file, fsync it,
             # atomically swap (rename is the commit), fsync the dir.
             tmp = self._epoch_path + ".tmp"
             with open(tmp, "wb") as f:
-                f.write(rec + struct.pack("<I", zlib.crc32(rec)))
-                f.flush()
-                os.fsync(f.fileno())
+                fsio.write(f, rec + struct.pack("<I", zlib.crc32(rec)))
+                fsio.fsync_file(f)
             os.replace(tmp, self._epoch_path)
-            dfd = os.open(os.path.dirname(self._epoch_path) or ".",
-                          os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            fsio.fsync_dir(os.path.dirname(self._epoch_path) or ".")
             self._epoch_f.close()
             self._epoch_f = open(self._epoch_path, "ab")
 

@@ -1,0 +1,276 @@
+"""Injectable filesystem layer for the durable write paths.
+
+The reference's only storage-fault story is "trust etcd/wal"; SURVEY.md
+§4 and the round-5 advisor findings (crash-window durability bugs that
+no test could reach) call for systematic storage fault injection.  This
+module is the seam: every durable-path write/fsync in storage/wal.py and
+the epoch-commit file in runtime/fused.py flows through the functions
+below, which are pass-throughs until a `StorageFaultInjector` is
+installed (chaos/ scenarios install one; production never does, so the
+cost is one None check per call).
+
+Fault classes (the chaos harness's storage axis):
+  * FAILED FSYNC — the Nth fsync matching a rule raises OSError,
+    exercising the paths that must fail a tick loudly instead of
+    acking unsynced data.  Counters are PER RULE (e.g. per peer WAL
+    directory): each peer's fsyncs are sequential even when the fused
+    barrier runs them from a worker pool, so rule counters are
+    deterministic where a global counter would race.
+  * SILENT FSYNC LOSS — from rule op N on, fsync reports success but
+    syncs nothing; combined with a crash this models a disk that lied.
+  * TORN WRITE / UNSYNCED LOSS — the injector records every write's
+    (offset, length) and every file's last really-synced size, so a
+    power-loss simulation can truncate files to exactly what a real
+    crash could leave: everything synced, plus at most a torn prefix of
+    one unsynced record (WAL._repair_tail's job to repair).
+
+The injector also keeps an ordered event log (("write"|"fsync"|
+"fsync_dir", path) tuples) so tests can assert durability ORDERING —
+e.g. "the data_dir was fsynced after the EPOCHS file was created,
+before the epoch was treated as committed".
+
+An ACTIVE injector forces the Python WAL backend (storage/wal.py
+_open_active checks `active()`): the C++ fast path does its framing and
+fdatasync behind one ctypes call, invisible to this seam.  Chaos
+scenarios trade the fast path for full observability; both backends
+produce byte-identical files, so what the faults exercise is the real
+on-disk format.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class FsyncFaultError(OSError):
+    """Injected fsync failure (distinguishable from real OS errors)."""
+
+
+class CrashPointError(RuntimeError):
+    """Injected mid-write power loss: the write reached the page cache
+    (the injector writes through) and the machine died before any
+    fsync.  Carries the rule's `tag` so the chaos runner knows which
+    peer's record to tear."""
+
+    def __init__(self, msg: str, tag=None):
+        super().__init__(msg)
+        self.tag = tag
+
+
+class _FsyncRule:
+    """One fault rule: matches paths by substring (`sub` in path + sep,
+    so a directory matches its own fsync and its files'), counts the
+    fsyncs and writes it sees, fails/skips/crashes at chosen ops."""
+
+    def __init__(self, substring: str, fail_at=(), silent_from=None,
+                 crash_write_at=(), tag=None):
+        self.substring = substring
+        self.fail_at = set(fail_at)
+        self.silent_from = silent_from
+        self.crash_write_at = set(crash_write_at)
+        self.tag = tag
+        self.ops = 0
+        self.write_ops = 0
+        self.failures = 0
+        self.lost = 0
+
+    def matches(self, path: str) -> bool:
+        return self.substring in path + os.sep
+
+
+class StorageFaultInjector:
+    """Deterministic storage fault state, shared across all files.
+
+    Thread-safe: the fused runtime fsyncs peers from a worker pool, so
+    the write log and rule counters are lock-protected.
+    """
+
+    def __init__(self):
+        self.rules: List[_FsyncRule] = []
+        self.fsync_ops = 0
+        self.write_ops = 0
+        self.fsync_failures = 0
+        self.events: List[Tuple[str, str]] = []
+        # path -> (offset before last write, bytes written) for torn-
+        # write crash simulation.
+        self.last_write: Dict[str, Tuple[int, int]] = {}
+        # path -> durable size at last REAL fsync (for unsynced-loss
+        # crash simulation; a path absent here was never synced).
+        self.synced_size: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add_rule(self, substring: str, fail_at=(),
+                 silent_from: Optional[int] = None,
+                 crash_write_at=(), tag=None) -> _FsyncRule:
+        rule = _FsyncRule(substring, fail_at, silent_from,
+                          crash_write_at, tag)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    # -- hooks called by the I/O functions below -----------------------
+
+    def on_write(self, path: str, offset: int, nbytes: int) -> None:
+        """Record one (already page-cache-visible) write; raises
+        CrashPointError AFTER recording when a rule's write counter
+        hits a crash point — the caller's write reached the file, the
+        fsync never will."""
+        with self._lock:
+            self.write_ops += 1
+            self.events.append(("write", path))
+            self.last_write[path] = (offset, nbytes)
+            for rule in self.rules:
+                if not rule.matches(path):
+                    continue
+                rule.write_ops += 1
+                if rule.write_ops in rule.crash_write_at:
+                    raise CrashPointError(
+                        f"injected mid-write power loss (write op "
+                        f"{rule.write_ops} of rule {rule.substring!r}) "
+                        f"on {path}", tag=rule.tag)
+
+    def on_fsync(self, path: str, size: int, kind: str = "fsync") -> bool:
+        """Count one fsync; returns False when the sync must be
+        silently skipped; raises FsyncFaultError for a failed one."""
+        with self._lock:
+            self.fsync_ops += 1
+            self.events.append((kind, path))
+            silent = False
+            for rule in self.rules:
+                if not rule.matches(path):
+                    continue
+                rule.ops += 1
+                if rule.ops in rule.fail_at:
+                    rule.failures += 1
+                    self.fsync_failures += 1
+                    raise FsyncFaultError(
+                        f"injected fsync failure (op {rule.ops} of rule "
+                        f"{rule.substring!r}) on {path}")
+                if rule.silent_from is not None \
+                        and rule.ops >= rule.silent_from:
+                    rule.lost += 1
+                    silent = True
+            if silent:
+                return False
+            if kind == "fsync":
+                self.synced_size[path] = size
+            return True
+
+    # -- crash simulation ----------------------------------------------
+
+    def tear_last_write(self, path: str,
+                        keep_fraction: float = 0.5) -> bool:
+        """Truncate `path` mid-way through its last recorded write —
+        the torn-record shape a power loss leaves.  Never cuts below
+        the last really-synced size (durable bytes cannot tear), and
+        never extends the file (the write may still sit in a userspace
+        buffer a simulated process kill already discarded).  Returns
+        True when something was actually torn."""
+        rec = self.last_write.get(path)
+        if rec is None or not os.path.isfile(path):
+            return False
+        offset, nbytes = rec
+        keep = offset + max(0, min(nbytes - 1,
+                                   int(nbytes * keep_fraction)))
+        keep = max(keep, self.synced_size.get(path, 0))
+        if keep >= os.path.getsize(path):
+            return False
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        return True
+
+    def drop_unsynced(self, path: str) -> bool:
+        """Truncate `path` back to its last REALLY-synced size (0 when
+        never synced) — what a power loss leaves on disk.  Returns True
+        when bytes were dropped."""
+        size = self.synced_size.get(path, 0)
+        if not os.path.isfile(path) or os.path.getsize(path) <= size:
+            return False
+        with open(path, "r+b") as f:
+            f.truncate(size)
+        return True
+
+    def tracked_paths(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self.last_write) | set(self.synced_size))
+
+
+_injector: Optional[StorageFaultInjector] = None
+
+
+def install(inj: StorageFaultInjector) -> StorageFaultInjector:
+    global _injector
+    _injector = inj
+    return inj
+
+
+def uninstall() -> None:
+    global _injector
+    _injector = None
+
+
+def active() -> bool:
+    return _injector is not None
+
+
+def injector() -> Optional[StorageFaultInjector]:
+    return _injector
+
+
+class installed:
+    """Context manager: `with fsio.installed(inj): ...` — uninstalls on
+    exit even when the scenario raises (tests must never leak an
+    injector into the next test's WAL traffic)."""
+
+    def __init__(self, inj: StorageFaultInjector):
+        self.inj = inj
+
+    def __enter__(self) -> StorageFaultInjector:
+        return install(self.inj)
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+# -- the I/O seam ------------------------------------------------------
+
+def write(f, data: bytes) -> None:
+    """File write, recorded for torn-write simulation.
+
+    Under an injector the write goes THROUGH to the file before the
+    crash-point check runs — page-cache semantics: a process kill keeps
+    what was written, a power loss keeps at most a torn prefix of it
+    (the injector's tear/drop helpers cut it back to what a real crash
+    could leave)."""
+    inj = _injector
+    if inj is None:
+        f.write(data)
+        return
+    offset = f.tell()
+    f.write(data)
+    f.flush()
+    inj.on_write(getattr(f, "name", ""), offset, len(data))
+
+
+def fsync_file(f) -> None:
+    """flush + fsync an open file object through the fault layer."""
+    f.flush()
+    inj = _injector
+    if inj is not None:
+        if not inj.on_fsync(getattr(f, "name", ""), f.tell()):
+            return                       # silent loss: report success
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory fd (dirent durability) through the fault layer."""
+    inj = _injector
+    if inj is not None:
+        if not inj.on_fsync(path, 0, kind="fsync_dir"):
+            return
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

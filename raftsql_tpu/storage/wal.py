@@ -90,6 +90,7 @@ _SEG_RE = re.compile(r"^wal-(\d+)\.log$")
 # RaftConfig share it).
 from raftsql_tpu.config import \
     WAL_SEGMENT_BYTES_DEFAULT as DEFAULT_SEGMENT_BYTES  # noqa: E402
+from raftsql_tpu.storage import fsio  # noqa: E402
 
 
 def _segment_paths(dirname: str) -> List[Tuple[int, str]]:
@@ -108,11 +109,7 @@ def _segment_paths(dirname: str) -> List[Tuple[int, str]]:
 
 
 def _fsync_dir(dirname: str) -> None:
-    dirfd = os.open(dirname, os.O_RDONLY)
-    try:
-        os.fsync(dirfd)
-    finally:
-        os.close(dirfd)
+    fsio.fsync_dir(dirname)
 
 
 @dataclass
@@ -297,7 +294,11 @@ class WAL:
         return off
 
     def _open_active(self) -> None:
-        if self._native_pref is not False:
+        # An active storage-fault injector (chaos scenarios) forces the
+        # Python backend: the C++ fast path frames and fdatasyncs behind
+        # one ctypes call, invisible to the fsio seam.  Both backends
+        # write byte-identical files.
+        if self._native_pref is not False and not fsio.active():
             from raftsql_tpu.native.build import load_native_wal
             lib = load_native_wal()
             if lib is not None:
@@ -315,8 +316,10 @@ class WAL:
     # -- write path ------------------------------------------------------
 
     def _write(self, body: bytes) -> None:
-        self._f.write(_HDR.pack(zlib.crc32(body), len(body)))
-        self._f.write(body)
+        # One write per record (not header-then-body): the fsio seam
+        # records it whole, so a simulated torn write tears a RECORD —
+        # the shape a real power loss leaves.
+        fsio.write(self._f, _HDR.pack(zlib.crc32(body), len(body)) + body)
         self._pending = True
         self._bytes += _HDR.size + len(body)
 
@@ -610,8 +613,7 @@ class WAL:
             if self._lib.wal_sync(self._h) != 0:
                 raise OSError("native WAL sync failed")
         else:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            fsio.fsync_file(self._f)
         self._pending = False
         if self._bytes >= self.segment_bytes:
             self._rotate()
@@ -640,8 +642,7 @@ class WAL:
             return
         if self._f is not None:
             f, self._f = self._f, None
-            f.flush()
-            os.fsync(f.fileno())
+            fsio.fsync_file(f)
             f.close()
 
     def close(self) -> None:

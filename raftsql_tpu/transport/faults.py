@@ -43,6 +43,42 @@ def random_drop(inbox: Inbox, key: jax.Array, p_drop: float) -> Inbox:
     return drop_messages(inbox, drop)
 
 
+def hold_messages(inbox: Inbox, hold: jax.Array) -> tuple[Inbox, Inbox]:
+    """Split an inbox for DELAY injection: (delivered, held).
+
+    `delivered` is the inbox with the held slots zeroed (they do not
+    arrive this tick); `held` contains ONLY the held slots (everything
+    else zeroed), to be re-injected into a later tick's inbox with
+    `release_messages`.  Mask semantics match `drop_messages` (True =
+    this slot is delayed).
+    """
+    return drop_messages(inbox, hold), drop_messages(inbox, ~hold)
+
+
+def release_messages(inbox: Inbox, held: Inbox) -> Inbox:
+    """Overlay previously-held message slots into a live inbox.
+
+    A held slot wins where it actually carries a message (nonzero type
+    code); per-slot the vote plane and the append plane overlay
+    independently, mirroring the dense Inbox's two-slot schema.  Any
+    same-slot message composed this tick is overwritten — the standard
+    overwrite-newest slot semantics, with "newest" being the delayed
+    delivery (raft tolerates both loss and reordering, so this is a
+    legal adversarial schedule).
+    """
+    v_m = held.v_type != 0          # [.., G, P_src]
+    a_m = held.a_type != 0
+
+    def overlay(name: str, live: jax.Array, hld: jax.Array) -> jax.Array:
+        m = v_m if name.startswith("v_") else a_m
+        while m.ndim < live.ndim:
+            m = m[..., None]
+        return jnp.where(m, hld, live)
+
+    return Inbox(*[overlay(n, getattr(inbox, n), getattr(held, n))
+                   for n in Inbox._fields])
+
+
 def partition_peer(inbox: Inbox, peer: int | jax.Array) -> Inbox:
     """Isolate one peer of a stacked cluster inbox: nothing in, nothing out.
 

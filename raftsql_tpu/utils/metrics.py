@@ -23,6 +23,15 @@ class NodeMetrics:
     compactions: int = 0
     snapshots_sent: int = 0
     snapshots_installed: int = 0
+    # Fault counters (chaos/ harness + storage fsio shim): injected
+    # message-plane faults and storage faults survived by this node.
+    # Zero outside chaos runs; exported so a chaos'd deployment's
+    # /metrics names what it was subjected to.
+    faults_dropped_msgs: int = 0
+    faults_delayed_msgs: int = 0
+    faults_partitions: int = 0
+    faults_crashes: int = 0
+    faults_fsync: int = 0
     # Per-phase tick wall time, accumulated by RaftNode.tick (SURVEY.md
     # §5.1 live profiling): staging (installs + inbox build) / device
     # step / WAL fsync / send / publish.
@@ -46,6 +55,13 @@ class NodeMetrics:
             "compactions": self.compactions,
             "snapshots_sent": self.snapshots_sent,
             "snapshots_installed": self.snapshots_installed,
+            "faults": {
+                "dropped_msgs": self.faults_dropped_msgs,
+                "delayed_msgs": self.faults_delayed_msgs,
+                "partitions": self.faults_partitions,
+                "crashes": self.faults_crashes,
+                "fsync": self.faults_fsync,
+            },
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
             "phase_ms_per_tick": {

@@ -30,6 +30,13 @@ jax.config.update("jax_platforms", "cpu")
 assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweeps (deep chaos schedules), excluded "
+        "from the tier-1 run via -m 'not slow'")
+
+
 def free_port() -> int:
     """An OS-assigned localhost port.  Bind-and-release has the usual
     TOCTOU window: the OS may hand the released port to someone else

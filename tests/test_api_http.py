@@ -178,3 +178,23 @@ def test_group_header_routes_to_second_group(server):
     r, data = req(server, "GET", b"SELECT * FROM main.g1",
                   headers={"X-Raft-Group": "1"})
     assert r.status == 200
+
+
+def test_put_propose_failure_answers_400(server, monkeypatch):
+    """An unexpected exception from rdb.propose (e.g. pipe/queue closed
+    during shutdown) must answer 400, not kill the handler and leave
+    the connection hanging with busy=True (ADVICE r5 low — the aio
+    plane's _do_put previously called propose outside any try)."""
+    def boom(self, query, group=0):
+        raise RuntimeError("injected propose failure")
+
+    # Class-level: the threaded plane closes over the RaftDB instance
+    # rather than exposing it.
+    monkeypatch.setattr(RaftDB, "propose", boom)
+    r, data = req(server, "PUT", b"INSERT INTO main.t VALUES (1)")
+    assert r.status == 400
+    assert b"injected propose failure" in data
+    # The server keeps serving once the fault clears.
+    monkeypatch.undo()
+    r, _ = req(server, "PUT", b"CREATE TABLE main.after_fault (v text)")
+    assert r.status == 204

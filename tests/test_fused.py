@@ -375,6 +375,83 @@ def test_multistep_uncommitted_dispatch_dropped_on_restart(tmp_path):
         node2.stop()
 
 
+def test_first_multistep_dispatch_uncommitted_dropped(tmp_path):
+    """ADVICE r5 high: a crash mid-barrier during the FIRST-ever
+    multi-step dispatch of a data_dir leaves epoch-1 BEGIN-framed
+    records durable on some peers with NO EPOCHS file (it is created
+    lazily at commit).  Restart must still run epoch repair (committed
+    epoch 0) and drop the frame everywhere — before the fix the
+    repair was gated on EPOCHS existing, and a durable vote grant
+    whose sender's state was lost would survive replay."""
+    cfg = mkcfg()
+    d = str(tmp_path / "n")
+    # Peer 1 fsynced its whole epoch-1 frame (a vote at term 5 and an
+    # entry); peer 2 tore mid-frame (BEGIN only); peer 3 wrote nothing.
+    # No EPOCHS file exists — the commit fsync never happened.
+    w0 = WAL(os.path.join(d, "p1"))
+    w0.epoch_mark(1, end=False)
+    w0.append_ranges([0], [1], [1], [5], [b"SET z 9"])
+    w0.set_hardstates(np.array([0]), np.array([5]), np.array([1]),
+                      np.array([0]))
+    w0.epoch_mark(1, end=True)
+    w0.sync()
+    w0.close()
+    w1 = WAL(os.path.join(d, "p2"))
+    w1.epoch_mark(1, end=False)
+    w1.sync()
+    w1.close()
+
+    node = FusedClusterNode(cfg, d, seed=5)
+    try:
+        # The whole uncommitted dispatch is gone on every peer: no
+        # remembered vote/term, no appended entry.
+        assert node._hard[0, 0, 0] == 0, "term from dropped frame"
+        assert node._hard[0, 0, 1] == -1, "vote from dropped frame"
+        assert node.plogs[0].length(0) == 0
+        # The cluster still elects and serves afterwards.
+        elect(node)
+        node.propose_many(0, [b"SET post repair"])
+        for _ in range(25):
+            node.tick()
+        post, _ = drain(node, 0)
+        assert any(q == "SET post repair" for (_, _, q) in post)
+    finally:
+        node.stop()
+
+
+def test_epoch_file_creation_fsyncs_directory(tmp_path):
+    """ADVICE r5 medium: the first _commit_epoch creates EPOCHS and
+    fsyncs its record, but the directory ENTRY must also be fsynced
+    before the epoch counts as committed — otherwise a crash can drop
+    the whole file while the peers' WAL bytes survive, and recovery
+    misclassifies committed (published/acked) dispatches as
+    uncommitted.  Crash simulation via the fsio event log: the
+    data_dir fsync must directly follow the EPOCHS record fsync."""
+    from raftsql_tpu.storage import fsio
+
+    cfg = mkcfg(groups=2)
+    d = str(tmp_path / "n")
+    inj = fsio.StorageFaultInjector()
+    with fsio.installed(inj):
+        node = FusedClusterNode(cfg, d, seed=2)
+        node._steps = 2
+        try:
+            elect(node)
+            node.propose_many(0, [b"SET a 1"])
+            for _ in range(6):
+                node.tick()
+            assert node._epoch_no > 0    # epoch framing was live
+        finally:
+            node.stop()
+    epath = os.path.join(d, "EPOCHS")
+    ev = inj.events
+    first = next(i for i, (kind, p) in enumerate(ev)
+                 if kind == "fsync" and p == epath)
+    assert ev[first + 1] == ("fsync_dir", d), (
+        "EPOCHS dirent not made durable before the epoch was treated "
+        f"as committed: {ev[first:first + 3]}")
+
+
 def test_epoch_commit_file_rotates_and_recovers(tmp_path):
     """The epoch-commit file keeps only what recovery needs: rotation
     rewrites it to the newest record once it crosses the threshold, and
