@@ -37,6 +37,16 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --seed $(SEED) --ticks 240 --runs 2
 
+# Sweep one seed through EVERY scenario family of the fault matrix
+# (asym partitions, clock skew, wire corruption, ENOSPC, fsync stalls,
+# compaction+crash, compaction+InstallSnapshot+crash, real-TCP chaos).
+# Deterministic families run twice and must digest-match; all families
+# must pass every invariant.  See README "Chaos fault matrix".
+#   make chaos-matrix SEED=17
+chaos-matrix:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --matrix --seed $(SEED)
+
 # ThreadSanitizer pass over the native WAL's locking (SURVEY.md §5.2):
 # 4 threads x appends/hardstate/compact/snapshot/sync on one handle.
 tsan:

@@ -16,28 +16,51 @@ systematic version, built on three seams the engine already exposes:
 
 Every scenario is a tick-indexed `ChaosSchedule` derived from ONE seed;
 re-running a seed reproduces the identical schedule (digest-checked by
-`make chaos`).  After (and during) every scenario four invariants are
+`make chaos`).  After (and during) every scenario the invariants are
 enforced (chaos/invariants.py): committed-entry durability across
 crashes, at most one leader per term, log matching across survivors,
-and linearizability of the KV plane's completed PUT/GET history.
+linearizability of the KV plane's completed PUT/GET history, commit
+monotonicity — plus, for the InstallSnapshot families, post-snapshot
+survivor convergence.  `make chaos-matrix` sweeps one seed through
+every scenario FAMILY (asymmetric partitions, per-peer clock skew,
+wire-frame corruption, ENOSPC, fsync stalls, compaction and
+InstallSnapshot crash interleavings, and the real TCP transport) —
+see the README's fault-matrix table.
 """
 from raftsql_tpu.chaos.invariants import (DurabilityLedger, ElectionSafety,
                                           InvariantViolation,
-                                          RegisterLinearizability)
-from raftsql_tpu.chaos.schedule import (LEADER_TARGET, ChaosSchedule,
+                                          RegisterLinearizability,
+                                          check_convergence)
+from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
+                                        ChaosSchedule, CorruptWindow,
                                         CrashEvent, DelayWindow, DropWindow,
-                                        FsyncFault, NodeChaosPlan, NodeCrash,
-                                        PartitionWindow, TornWriteFault,
-                                        generate, generate_node_plan)
+                                        EnospcFault, FsyncFault, FsyncStall,
+                                        NodeChaosPlan, NodeCrash,
+                                        PartitionWindow, SkewWindow,
+                                        TcpChaosPlan, TornWriteFault,
+                                        generate, generate_asym,
+                                        generate_compact,
+                                        generate_corrupt_plan,
+                                        generate_enospc, generate_node_plan,
+                                        generate_skew,
+                                        generate_snapshot_plan,
+                                        generate_stall, generate_tcp_plan)
 from raftsql_tpu.chaos.scenarios import (FusedChaosRunner,
-                                         NodeClusterChaosRunner)
+                                         NodeClusterChaosRunner,
+                                         SnapshotChaosRunner,
+                                         TcpClusterChaosRunner)
 
 __all__ = [
-    "LEADER_TARGET", "ChaosSchedule", "CrashEvent", "DelayWindow",
-    "DropWindow", "FsyncFault", "NodeChaosPlan", "NodeCrash",
-    "PartitionWindow", "TornWriteFault", "generate",
-    "generate_node_plan",
+    "LEADER_TARGET", "AsymPartitionWindow", "ChaosSchedule",
+    "CorruptWindow", "CrashEvent", "DelayWindow", "DropWindow",
+    "EnospcFault", "FsyncFault", "FsyncStall", "NodeChaosPlan",
+    "NodeCrash", "PartitionWindow", "SkewWindow", "TcpChaosPlan",
+    "TornWriteFault", "generate", "generate_asym", "generate_compact",
+    "generate_corrupt_plan", "generate_enospc", "generate_node_plan",
+    "generate_skew", "generate_snapshot_plan", "generate_stall",
+    "generate_tcp_plan",
     "DurabilityLedger", "ElectionSafety", "InvariantViolation",
-    "RegisterLinearizability", "FusedChaosRunner",
-    "NodeClusterChaosRunner",
+    "RegisterLinearizability", "check_convergence", "FusedChaosRunner",
+    "NodeClusterChaosRunner", "SnapshotChaosRunner",
+    "TcpClusterChaosRunner",
 ]

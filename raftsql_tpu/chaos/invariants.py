@@ -75,33 +75,40 @@ def check_log_matching(tick: int, commits: np.ndarray, plogs) -> None:
 
     commits: [P, G] committed indexes; plogs: per-peer payload logs
     (storage/log.py — `slice_columns(g, start, n) -> (terms, datas)`).
-    Compares every pair's overlap; scenarios here never compact, so the
-    full prefix is readable from index 1.
+    Compares every pair's overlap ABOVE both peers' compaction floors:
+    compacting scenarios (the compact/InstallSnapshot families) drop
+    prefixes at different rates per peer, so the comparable region of a
+    pair is (max(floor_a, floor_b), min(commit_a, commit_b)].  Entries
+    below a peer's floor were already audited while they were live —
+    the floor only ever covers published (committed) entries.
     """
     P, G = commits.shape
     for g in range(G):
         ref_p: Optional[int] = None
-        ref: Optional[Tuple[list, list]] = None
+        ref_c = 0
         for p in range(P):
             c = int(commits[p, g])
             if c <= 0:
                 continue
-            terms, datas = plogs[p].slice_columns(g, 1, c)
-            if len(datas) != c:
+            if plogs[p].length(g) < c:
                 raise InvariantViolation(
                     f"t={tick} p={p} g={g}: payload log shorter than "
-                    f"commit ({len(datas)} < {c})")
-            if ref is None:
-                ref_p, ref = p, (list(terms), list(datas))
+                    f"commit ({plogs[p].length(g)} < {c})")
+            if ref_p is None:
+                ref_p, ref_c = p, c
                 continue
-            n = min(c, len(ref[1]))
-            if list(terms[:n]) != ref[0][:n] \
-                    or list(datas[:n]) != ref[1][:n]:
-                raise InvariantViolation(
-                    f"t={tick} g={g}: committed prefixes diverge "
-                    f"between p{ref_p} and p{p}")
-            if c > len(ref[1]):
-                ref_p, ref = p, (list(terms), list(datas))
+            lo = max(plogs[p].start(g), plogs[ref_p].start(g))
+            n = min(c, ref_c) - lo
+            if n > 0:
+                terms, datas = plogs[p].slice_columns(g, lo + 1, n)
+                rterms, rdatas = plogs[ref_p].slice_columns(g, lo + 1, n)
+                if list(terms) != list(rterms) \
+                        or list(datas) != list(rdatas):
+                    raise InvariantViolation(
+                        f"t={tick} g={g}: committed prefixes diverge "
+                        f"between p{ref_p} and p{p}")
+            if c > ref_c:
+                ref_p, ref_c = p, c
 
 
 class DurabilityLedger:
@@ -121,10 +128,17 @@ class DurabilityLedger:
         return len(self._committed)
 
     def verify_replay(self, replayed: Dict[Tuple[int, int], bytes],
-                      context: str = "") -> None:
+                      context: str = "",
+                      floors: Optional[np.ndarray] = None) -> None:
         """`replayed` maps (group, index) -> payload from the restart's
-        replay stream; it must be a superset of everything recorded."""
+        replay stream; it must be a superset of everything recorded
+        ABOVE the replaying peer's compaction floors (`floors[g]`,
+        optional): a compacted prefix legitimately does not replay — its
+        entries live on in the state-machine snapshot the compaction was
+        gated on, which the runner carries forward separately."""
         for (g, i), payload in self._committed.items():
+            if floors is not None and i <= int(floors[g]):
+                continue
             got = replayed.get((g, i))
             if got is None:
                 raise InvariantViolation(
@@ -134,6 +148,37 @@ class DurabilityLedger:
                 raise InvariantViolation(
                     f"{context}: committed entry g{g} i{i} changed "
                     f"across restart ({payload!r} -> {got!r})")
+
+
+def check_convergence(group: int, survivors: List[Tuple[int, int, Dict]],
+                      context: str = "") -> None:
+    """CONVERGENCE (post-snapshot survivors): after a fault-free heal
+    window, every surviving peer of a group must have applied to the
+    SAME index and hold IDENTICAL state-machine state — a peer rebuilt
+    through InstallSnapshot included.  This is the end-to-end check the
+    per-entry invariants cannot give: an installed snapshot could be
+    internally consistent yet wrong (stale applied index, dropped dedup
+    window, a key lost in blob serialization) and still pass log
+    matching, because the installed peer no longer HAS the log below
+    its floor to compare.
+
+    survivors: [(peer, applied_index, state_dict)] for live peers.
+    """
+    if len(survivors) < 2:
+        return
+    tops = {a for (_, a, _) in survivors}
+    if len(tops) != 1:
+        raise InvariantViolation(
+            f"{context}: g{group} survivors failed to converge: "
+            f"applied indexes "
+            f"{sorted((p, a) for (p, a, _) in survivors)}")
+    _, _, ref = survivors[0]
+    for (p, _, st) in survivors[1:]:
+        if st != ref:
+            raise InvariantViolation(
+                f"{context}: g{group} survivor p{p} state diverges "
+                f"from p{survivors[0][0]} at applied "
+                f"{survivors[0][1]}")
 
 
 class RegisterLinearizability:

@@ -1,17 +1,37 @@
-"""`make chaos` entry point: run a seeded chaos scenario and prove it
-reproduces.
+"""`make chaos` / `make chaos-matrix` entry points: run seeded chaos
+scenarios and prove they reproduce.
 
     python -m raftsql_tpu.chaos.run --seed 0 --ticks 240 --runs 2
+    python -m raftsql_tpu.chaos.run --matrix --seed 0
+    python -m raftsql_tpu.chaos.run --family enospc --seed 3
 
-Generates the seed's ChaosSchedule (>= 2 partitions, >= 2 crash/restart
-events, >= 1 injected fsync fault, plus a torn-write power loss), runs
-it against a fresh FusedClusterNode data dir per run, and prints one
-JSON line per run.  With --runs > 1 the runs must produce IDENTICAL
-schedule and result digests — determinism is an asserted property, not
-a hope.  Exit code 0 only when every run passed all four invariants
-(durability, single leader per term, log matching, KV linearizability
-— violations raise and exit 1), the digests agree, and at least one
-storage fault actually fired.
+Default mode generates the seed's full ChaosSchedule (>= 2 partitions,
+>= 2 crash/restart events, >= 1 injected fsync fault, plus a torn-write
+power loss), runs it against a fresh FusedClusterNode data dir per run,
+and prints one JSON line per run.  With --runs > 1 the runs must produce
+IDENTICAL schedule and result digests — determinism is an asserted
+property, not a hope.
+
+--matrix sweeps ONE seed through every scenario FAMILY of the fault
+matrix (ROADMAP open items → chaos/schedule.py generators):
+
+    asym             one-directional partitions        (fused plane)
+    skew             per-peer clock skew               (fused plane)
+    corrupt          wire-frame corruption             (lockstep wire plane)
+    enospc           disk-full on WAL append           (fused plane)
+    fsync_stall      slow-disk fsync latency           (fused plane)
+    compact          compaction + crash interleaving   (fused plane)
+    snapshot         compaction + InstallSnapshot + crash (lockstep plane)
+    tcp              drops/corruption/asym/delays      (REAL TCP transport)
+
+Every family except `tcp` is run twice and must reproduce identical
+schedule + result digests.  The TCP family crosses real kernel sockets,
+so arrival interleaving is not virtualizable: its SCHEDULE digest is
+deterministic and its invariants must hold, but the committed history
+is not bit-reproducible (documented in the README fault matrix) — it
+runs once.  Exit code 0 only when every family passed every invariant
+(violations raise), every deterministic family reproduced, and each
+family's signature faults actually fired.
 """
 from __future__ import annotations
 
@@ -20,6 +40,85 @@ import json
 import os
 import sys
 import tempfile
+
+
+def _run_fused(sched, steps: int = 1) -> dict:
+    from raftsql_tpu.chaos.scenarios import FusedChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return FusedChaosRunner(sched, d, steps=steps).run()
+
+
+def _check(ok: bool, msg: str) -> bool:
+    if not ok:
+        print(f"CHAOS FAIL: {msg}", file=sys.stderr)
+    return ok
+
+
+# family -> (runner, deterministic, fired_predicate)
+def _family_specs():
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.scenarios import (NodeClusterChaosRunner,
+                                             SnapshotChaosRunner,
+                                             TcpClusterChaosRunner)
+
+    def node_run(runner_cls, plan):
+        with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+            return runner_cls(plan, d).run()
+
+    return {
+        "asym": (lambda seed: _run_fused(S.generate_asym(seed)), True,
+                 lambda r: r["asym_partitions"] >= 2),
+        "skew": (lambda seed: _run_fused(S.generate_skew(seed)), True,
+                 lambda r: r["skew_ticks"] > 0),
+        "corrupt": (lambda seed: node_run(NodeClusterChaosRunner,
+                                          S.generate_corrupt_plan(seed)),
+                    True, lambda r: r["corrupt_frames"] > 0),
+        "enospc": (lambda seed: _run_fused(S.generate_enospc(seed)), True,
+                   lambda r: r["enospc_hits"] >= 2),
+        "fsync_stall": (lambda seed: _run_fused(S.generate_stall(seed)),
+                        True, lambda r: r["fsync_stalls"] > 0),
+        "compact": (lambda seed: _run_fused(S.generate_compact(seed)),
+                    True, lambda r: r["compactions"] > 0
+                    and r["crashes"] >= 2),
+        "snapshot": (lambda seed: node_run(SnapshotChaosRunner,
+                                           S.generate_snapshot_plan(seed)),
+                     True, lambda r: r["snapshots_installed"] > 0
+                     and r["compactions"] > 0 and r["crashes"] >= 2),
+        "tcp": (lambda seed: node_run(TcpClusterChaosRunner,
+                                      S.generate_tcp_plan(seed)),
+                False, lambda r: r["corrupt_frames_dropped"] > 0
+                and r["commits"] > 20),
+    }
+
+
+def _digests(r: dict):
+    return (r.get("schedule_digest") or r.get("plan_digest"),
+            r.get("result_digest"))
+
+
+def run_matrix(seed: int, only=None) -> int:
+    specs = _family_specs()
+    ok = True
+    for name, (run_fn, deterministic, fired) in specs.items():
+        if only and name not in only:
+            continue
+        reports = [run_fn(seed)]
+        if deterministic:
+            reports.append(run_fn(seed))
+            ok &= _check(
+                _digests(reports[0]) == _digests(reports[1]),
+                f"family {name}: non-deterministic "
+                f"({_digests(reports[0])} != {_digests(reports[1])})")
+        ok &= _check(fired(reports[0]),
+                     f"family {name}: signature fault never fired "
+                     f"({reports[0]})")
+        out = {"family": name, "seed": seed,
+               "deterministic": deterministic, **reports[0]}
+        print(json.dumps(out, sort_keys=True))
+    if ok:
+        print(f"chaos matrix ok: seed={seed} families="
+              f"{','.join(only or specs)}")
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -31,32 +130,32 @@ def main(argv=None) -> int:
                     help="repeat the seed and require identical digests")
     ap.add_argument("--steps", type=int, default=1,
                     help="fused steps per dispatch (epoch-framed when >1)")
+    ap.add_argument("--matrix", action="store_true",
+                    help="sweep one seed through every scenario family")
+    ap.add_argument("--family", action="append", default=None,
+                    help="run only this family (repeatable; implies "
+                         "--matrix)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.matrix or args.family:
+        return run_matrix(args.seed, only=args.family)
+
     from raftsql_tpu.chaos.schedule import generate
-    from raftsql_tpu.chaos.scenarios import FusedChaosRunner
 
     sched = generate(args.seed, ticks=args.ticks)
     reports = []
     for run in range(args.runs):
-        with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
-            r = FusedChaosRunner(sched, d, steps=args.steps).run()
+        r = _run_fused(sched, steps=args.steps)
         r["run"] = run
         reports.append(r)
         print(json.dumps(r, sort_keys=True))
-    ok = True
-    if not all(r["fsync_faults"] >= 1 and r["torn_writes"] >= 1
-               for r in reports):
-        print("CHAOS FAIL: a scheduled storage fault never fired",
-              file=sys.stderr)
-        ok = False
+    ok = _check(all(r["fsync_faults"] >= 1 and r["torn_writes"] >= 1
+                    for r in reports),
+                "a scheduled storage fault never fired")
     digests = {(r["schedule_digest"], r["result_digest"])
                for r in reports}
-    if len(digests) != 1:
-        print(f"CHAOS FAIL: non-deterministic run: {digests}",
-              file=sys.stderr)
-        ok = False
+    ok &= _check(len(digests) == 1, f"non-deterministic run: {digests}")
     if ok:
         print(f"chaos ok: seed={args.seed} ticks={args.ticks} "
               f"schedule={reports[0]['schedule_digest']} "

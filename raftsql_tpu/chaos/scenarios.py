@@ -2,19 +2,29 @@
 `ChaosSchedule`, injecting faults at every seam, checking invariants
 every tick.
 
-Two runners, matching the two deployment shapes:
+Four runners, covering three planes:
 
   * `FusedChaosRunner` — the fused single-dispatch runtime
     (runtime/fused.py FusedClusterNode).  Fully deterministic: one
     thread drives `tick()` manually, fault masks are host-generated
     from the schedule's seed, crashes are simulated in-process, and
     the run's result digest is reproducible bit-for-bit from the seed
-    (`make chaos` proves it by running a seed twice).
+    (`make chaos` proves it by running a seed twice).  Also carries
+    the asym-partition, per-peer clock-skew, ENOSPC, fsync-stall, and
+    compaction-interleaving families.
   * `NodeClusterChaosRunner` — the threaded/distributed runtime
     (runtime/node.py RaftNode) as a LOCKSTEP cluster over the loopback
-    transport: per-node crash/restart, leader-targeted kills, and
-    FaultPlan partitions, with per-node durability and cross-node log
-    matching checked from the commit streams.
+    transport: per-node crash/restart, leader-targeted kills, FaultPlan
+    partitions (bidirectional and one-directional), per-node timer
+    skew, and seeded wire-frame corruption, with per-node durability
+    and cross-node log matching checked from the commit streams.
+  * `SnapshotChaosRunner` — the node runner plus per-node KV state
+    machines, aggressive compaction, and InstallSnapshot transfers,
+    ending in the post-snapshot survivor CONVERGENCE invariant.
+  * `TcpClusterChaosRunner` — the same node cluster over the REAL TCP
+    transport (transport/tcp.py) with its injectable send-side fault
+    seam: drops, one-directional blocks, frame corruption (CRC-dropped
+    and counted at the receivers), delayed frames.
 
 Crash simulation ("hard crash"): every open durable fd of the dying
 node is redirected to /dev/null before the object is abandoned — a
@@ -32,6 +42,8 @@ import hashlib
 import json
 import os
 import queue
+import socket
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -42,17 +54,20 @@ from raftsql_tpu.chaos.invariants import (CommitMonotonic,
                                           DurabilityLedger, ElectionSafety,
                                           InvariantViolation,
                                           RegisterLinearizability,
+                                          check_convergence,
                                           check_log_matching)
 from raftsql_tpu.chaos.schedule import (LEADER_TARGET, ChaosSchedule,
-                                        NodeChaosPlan)
+                                        NodeChaosPlan, TcpChaosPlan)
 from raftsql_tpu.config import LEADER, RaftConfig
 from raftsql_tpu.runtime.db import _expand_commit_item, iter_plain_batches
 from raftsql_tpu.runtime.fused import FusedClusterNode
 from raftsql_tpu.runtime.node import CLOSED, RaftNode
 from raftsql_tpu.storage import fsio
-from raftsql_tpu.transport.faults import (drop_messages, hold_messages,
-                                          partition_peer, release_messages)
+from raftsql_tpu.transport.faults import (asym_partition, drop_messages,
+                                          hold_messages, partition_peer,
+                                          release_messages)
 from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
+from raftsql_tpu.transport.tcp import SendFaults, TcpTransport
 
 DEAD_ROLE = -1          # role code for a crashed node's safety-matrix row
 
@@ -150,9 +165,13 @@ class FusedChaosRunner:
                  cfg: Optional[RaftConfig] = None, steps: int = 1):
         self.sched = schedule
         self.data_dir = data_dir
+        # Compacting schedules get a small device window so the clamped
+        # compaction floor (keep >= log_window) actually advances within
+        # a fast run's entry counts.
         self.cfg = cfg or RaftConfig(
             num_groups=4, num_peers=schedule_peers(schedule),
-            log_window=64, max_entries_per_msg=4, election_ticks=10,
+            log_window=16 if schedule.compact_every else 64,
+            max_entries_per_msg=4, election_ticks=10,
             heartbeat_ticks=1, tick_interval_s=0.0)
         self.steps = steps
         self.node: Optional[FusedClusterNode] = None
@@ -166,10 +185,14 @@ class FusedChaosRunner:
         self._held: List[Tuple[int, object]] = []
         self._pending_reads: List[Tuple[str, int, int, tuple]] = []
         self._part_peer: Dict[int, int] = {}
+        self._asym_src: Dict[int, int] = {}
         self._wseq = 0
+        self.final_metrics = None       # NodeMetrics after run()
         self.report: Dict[str, int] = {
             "crashes": 0, "restarts": 0, "partitions": 0,
+            "asym_partitions": 0, "skew_ticks": 0,
             "fsync_faults": 0, "torn_write_faults": 0, "torn_writes": 0,
+            "enospc_hits": 0, "fsync_stalls": 0, "compactions": 0,
             "unsynced_files_dropped": 0, "dropped_slots": 0,
             "delayed_slots": 0, "log_match_checks": 0,
         }
@@ -192,13 +215,26 @@ class FusedChaosRunner:
                     if d:
                         replayed[(g, base + 1 + off)] = d
                         order.append((g, base + 1 + off, d))
+        # Compaction floors: the replay legitimately starts above them
+        # (compact() only ever drops published entries — the publish
+        # cursor gates the floor).
+        floors = np.array([node.plogs[0].start(g)
+                           for g in range(self.cfg.num_groups)], np.int64)
         if not first:
             self.ledger.verify_replay(
-                replayed, context=f"restart {self.report['restarts']}")
+                replayed, context=f"restart {self.report['restarts']}",
+                floors=floors)
             self.report["restarts"] += 1
-        # Rebuild the client-visible KV state from the replay (per-group
-        # index order; groups are independent key spaces).
+        # Rebuild the client-visible KV state: the compacted prefix from
+        # the durability ledger (the runner's stand-in for the state-
+        # machine snapshot real compaction is gated on), then the
+        # replayed stream above it (per-group index order; groups are
+        # independent key spaces).
         self._kv.clear()
+        for g, i, d in sorted(
+                (g, i, d) for (g, i), d in self.ledger._committed.items()
+                if i <= floors[g]):
+            self._apply(g, i, d)
         for g, i, d in sorted(order):
             self._apply(g, i, d)
         self._applied = node._applied[0].copy()
@@ -290,6 +326,28 @@ class FusedChaosRunner:
                     self._part_peer[wi] = peer
                     self.report["partitions"] += 1
                 node.inboxes = partition_peer(node.inboxes, peer)
+        for wi, w in enumerate(self.sched.asym_partitions):
+            if w.start <= t < w.end:
+                src = self._asym_src.get(wi)
+                if src is None:
+                    # LEADER_TARGET: the window's one-directional cut is
+                    # anchored on whoever leads group 0 at its opening
+                    # tick — "dst goes deaf to its leader".
+                    src = w.src if w.src >= 0 \
+                        else max(self.node.leader_of(0), 0)
+                    self._asym_src[wi] = src
+                    self.report["asym_partitions"] += 1
+                node.inboxes = asym_partition(node.inboxes, src, w.dst)
+
+    def _skew_for(self, t: int) -> Optional[np.ndarray]:
+        """Per-peer timer_inc for tick t, None = lockstep.  Later
+        windows override earlier ones on overlap (schedules keep them
+        disjoint in practice)."""
+        ti = None
+        for w in self.sched.skews:
+            if w.start <= t < w.end:
+                ti = np.asarray(w.incs, np.int32)
+        return ti
 
     # -- invariants ----------------------------------------------------
 
@@ -314,6 +372,13 @@ class FusedChaosRunner:
         for f in self.sched.torn_writes:
             inj.add_rule(os.sep + f"p{f.peer + 1}" + os.sep,
                          crash_write_at=(f.op,), tag=f.peer)
+        for f in self.sched.enospc_faults:
+            inj.add_rule(os.sep + f"p{f.peer + 1}" + os.sep,
+                         enospc_write_at=(f.op,))
+        for f in self.sched.fsync_stalls:
+            inj.add_rule(os.sep + f"p{f.peer + 1}" + os.sep,
+                         stall_at=tuple(range(f.op, f.op + f.count)),
+                         stall_s=f.stall_s)
         crash_at = {ev.tick: ev for ev in self.sched.crashes}
         rng = np.random.default_rng(self.sched.seed + 1)
         with fsio.installed(inj):
@@ -326,8 +391,23 @@ class FusedChaosRunner:
                                             ev.tear_peer)
                     self._apply_faults(t, rng)
                     self._issue(rng)
+                    ti = self._skew_for(t)
+                    if ti is not None:
+                        self.report["skew_ticks"] += int(
+                            np.abs(ti.astype(np.int64) - 1).sum())
+                    self.node.timer_inc = ti
                     try:
                         self.node.tick()
+                    except fsio.EnospcError:
+                        # Disk full on a WAL append: the tick's durable
+                        # barrier cannot complete, so this is fatal
+                        # (same posture as a failed fsync) — crash +
+                        # restart.  The consumed trigger models the
+                        # operator freeing space; the retried record
+                        # lands on a clean tail.
+                        self.report["enospc_hits"] += 1
+                        self._crash_restart(t, power_loss=False)
+                        continue
                     except fsio.FsyncFaultError:
                         # etcd posture: a failed WAL fsync is fatal —
                         # crash the process rather than ack unsynced
@@ -354,18 +434,31 @@ class FusedChaosRunner:
                                                self.node._applied[0])
                     self._resolve_reads()
                     self._observe(t)
+                    if self.sched.compact_every and t \
+                            and t % self.sched.compact_every == 0 \
+                            and self.node.compact(
+                                keep=self.sched.compact_keep):
+                        self.report["compactions"] += 1
                 # Final deep checks + a restart pass so the run always
                 # ends with a full durability audit.
                 check_log_matching(self.sched.ticks,
                                    self.node._hard[:, :, 2],
                                    self.node.plogs)
                 self.report["log_match_checks"] += 1
+                self.node.timer_inc = None
                 self._crash_restart(self.sched.ticks)
+                self.report["fsync_stalls"] = inj.fsync_stalls
                 m = self.node.metrics
                 m.faults_dropped_msgs = self.report["dropped_slots"]
                 m.faults_delayed_msgs = self.report["delayed_slots"]
                 m.faults_partitions = self.report["partitions"]
                 m.faults_fsync = self.report["fsync_faults"]
+                m.faults_enospc = self.report["enospc_hits"]
+                m.faults_fsync_stalls = self.report["fsync_stalls"]
+                m.faults_skew_ticks = self.report["skew_ticks"]
+                # Survives node teardown so tests can assert the
+                # exported counters (the /metrics surface).
+                self.final_metrics = m
             finally:
                 node, self.node = self.node, None
                 if node is not None:
@@ -398,9 +491,17 @@ def schedule_peers(schedule: ChaosSchedule) -> int:
     peers = 3
     for w in schedule.partitions:
         peers = max(peers, w.peer + 1)
+    for w in schedule.asym_partitions:
+        peers = max(peers, w.src + 1, w.dst + 1)
+    for w in schedule.skews:
+        peers = max(peers, len(w.incs))
     for ev in schedule.crashes:
         peers = max(peers, ev.tear_peer + 1)
     for f in schedule.fsync_faults:
+        peers = max(peers, f.peer + 1)
+    for f in schedule.enospc_faults:
+        peers = max(peers, f.peer + 1)
+    for f in schedule.fsync_stalls:
         peers = max(peers, f.peer + 1)
     return peers
 
@@ -437,7 +538,51 @@ class NodeClusterChaosRunner:
         self._published: List[Dict[Tuple[int, int], str]] = [
             {} for _ in range(peers)]
         self.report = {"crashes": 0, "restarts": 0, "partitions": 0,
-                       "commits": 0}
+                       "asym_partitions": 0, "skew_ticks": 0,
+                       "corrupt_frames": 0, "commits": 0}
+        self._asym_src: Dict[int, int] = {}
+        self._t = 0
+        # Wire-corruption seam: mangle encoded frames during the plan's
+        # corruption windows; the CRC framing must catch every mangled
+        # frame (hub.on_corrupt charges the receiving node's metrics).
+        # The rng draws per route call, which is deterministic here —
+        # the lockstep tick order serializes every send.
+        if plan.corruptions:
+            rng_c = np.random.default_rng(plan.seed + 3)
+
+            def _mangle(src: int, dst: int, blob: bytes) -> bytes:
+                for w in self.plan.corruptions:
+                    if w.start <= self._t < w.end \
+                            and rng_c.random() < w.p:
+                        i = int(rng_c.integers(0, len(blob)))
+                        return blob[:i] + bytes([blob[i] ^ 0x5A]) \
+                            + blob[i + 1:]
+                return blob
+
+            self.hub.mangler = _mangle
+            self.hub.on_corrupt = self._note_corrupt
+
+    def _note_corrupt(self, src: int, dst: int) -> None:
+        self.report["corrupt_frames"] += 1
+        n = self.nodes[dst - 1]
+        if n is not None:
+            n.metrics.faults_corrupt_frames += 1
+
+    # Subclass hooks (SnapshotChaosRunner): replay observation, per-tick
+    # work (compaction cadence), commit application, final invariants.
+    def _on_replay(self, p: int,
+                   replayed: Dict[Tuple[int, int], str],
+                   node: RaftNode) -> None:
+        pass
+
+    def _apply_commit(self, p: int, g: int, idx: int, sql: str) -> None:
+        pass
+
+    def _post_tick(self, t: int, healing: bool) -> None:
+        pass
+
+    def _final_check(self) -> None:
+        pass
 
     def _data_dir(self, p: int) -> str:
         return os.path.join(self.tmpdir, f"chaos-node-{p + 1}")
@@ -464,12 +609,19 @@ class NodeClusterChaosRunner:
             for (g, idx, sql) in _expand_commit_item(item, n):
                 replayed[(g, idx)] = sql
         for (g, idx), sql in self._published[p].items():
+            if idx <= n.payload_log.start(g):
+                # Compacted away before the crash: the entry lives on in
+                # the state-machine snapshot the compaction was gated on
+                # (the SnapshotChaosRunner's SM carries it; replay
+                # legitimately starts above the floor).
+                continue
             got = replayed.get((g, idx))
             if got != sql:
                 raise InvariantViolation(
                     f"node {p}: committed entry g{g} i{idx} "
                     f"{'lost' if got is None else 'changed'} across "
                     f"restart")
+        self._on_replay(p, replayed, n)
         return n
 
     def _resolve(self, peer: int) -> int:
@@ -498,6 +650,7 @@ class NodeClusterChaosRunner:
                             f"log matching: node {p} committed g{g} "
                             f"i{idx} {sql!r} but {prev!r} was committed")
                     self._published[p][(g, idx)] = sql
+                    self._apply_commit(p, g, idx, sql)
                     self.report["commits"] += 1
 
     def _observe(self, t: int) -> None:
@@ -524,11 +677,17 @@ class NodeClusterChaosRunner:
         for c in self.plan.crashes:
             crash_at.setdefault(c.tick, []).append(c)
         down_until: Dict[int, int] = {}
+        total = self.plan.ticks + self.plan.heal_ticks
         with fsio.installed(inj):
             for p in range(self.P):
                 self.nodes[p] = self._boot(p)
             try:
-                for t in range(self.plan.ticks):
+                for t in range(total):
+                    self._t = t
+                    # The heal window: no new faults, no new load —
+                    # in-flight recovery (restarts, transfers) finishes
+                    # and the survivors must converge (_final_check).
+                    healing = t >= self.plan.ticks
                     for c in crash_at.get(t, ()):
                         p = self._resolve(c.peer)
                         if self.nodes[p] is None:
@@ -543,26 +702,288 @@ class NodeClusterChaosRunner:
                         self.nodes[p] = self._boot(p)
                         self.report["restarts"] += 1
                     self.hub.faults.heal()
-                    for w in self.plan.partitions:
-                        if w.start <= t < w.end:
-                            if t == w.start:
-                                self.report["partitions"] += 1
-                            self.hub.faults.isolate(
-                                w.peer + 1, range(1, self.P + 1))
-                    if rng.random() < self.plan.prop_rate:
-                        alive = [p for p, n in enumerate(self.nodes)
-                                 if n is not None]
-                        src = alive[int(rng.integers(0, len(alive)))]
-                        g = int(rng.integers(0, self.cfg.num_groups))
-                        self.nodes[src].propose(
-                            g, f"SET k{g} v{t}".encode())
-                    for n in self.nodes:
-                        if n is not None:
-                            n.tick()
+                    incs: Optional[Tuple[int, ...]] = None
+                    if not healing:
+                        for w in self.plan.partitions:
+                            if w.start <= t < w.end:
+                                if t == w.start:
+                                    self.report["partitions"] += 1
+                                self.hub.faults.isolate(
+                                    w.peer + 1, range(1, self.P + 1))
+                        for wi, w in enumerate(self.plan.asym_partitions):
+                            if w.start <= t < w.end:
+                                src = self._asym_src.get(wi)
+                                if src is None:
+                                    src = self._resolve(w.src)
+                                    self._asym_src[wi] = src
+                                    self.report["asym_partitions"] += 1
+                                self.hub.faults.block(src + 1, w.dst + 1)
+                        for w in self.plan.skews:
+                            if w.start <= t < w.end:
+                                incs = w.incs
+                        if rng.random() < self.plan.prop_rate:
+                            alive = [p for p, n in enumerate(self.nodes)
+                                     if n is not None]
+                            src = alive[int(rng.integers(0, len(alive)))]
+                            g = int(rng.integers(0, self.cfg.num_groups))
+                            self.nodes[src].propose(
+                                g, f"SET k{g} v{t}".encode())
+                    for p, n in enumerate(self.nodes):
+                        if n is None:
+                            continue
+                        inc = 1 if incs is None else int(incs[p])
+                        if inc != 1:
+                            self.report["skew_ticks"] += abs(inc - 1)
+                            n.metrics.faults_skew_ticks += abs(inc - 1)
+                        n.tick(timer_inc=inc)
                     self._drain_live()
                     self._observe(t)
+                    self._post_tick(t, healing)
+                self._final_check()
             finally:
                 for n in self.nodes:
                     if n is not None:
                         n.stop()
+        return {"plan_digest": self.plan.digest(),
+                "result_digest": self._result_digest(), **self.report}
+
+    def _result_digest(self) -> str:
+        """Digest of the run's committed (unwrapped) history + fault
+        counts: identical across two runs of one plan — envelope ids
+        randomize WAL bytes but never the lockstep schedule or the
+        decoded commit stream."""
+        hist = sorted((g, i, s) for (g, i), s in self._hist.items())
+        blob = json.dumps({"hist": hist, "report": self.report},
+                          sort_keys=True, separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class SnapshotChaosRunner(NodeClusterChaosRunner):
+    """Aggressive compaction + InstallSnapshot + crash interleavings.
+
+    Each node carries a tiny per-group KV state machine applied from
+    its commit stream (this runner IS the apply plane), exposed through
+    the node's snapshot provider/installer hooks as a JSON blob, and
+    compacts its own log on the plan's cadence gated on its own applied
+    index — the RaftDB calling convention (runtime/db.py).  The plan
+    crashes one follower long enough that every retained log floor
+    passes it by: its restart can only be served by a full state
+    transfer, while a second (leader-targeted) crash lands after the
+    transfer window.  After the fault-free heal window the survivors
+    must CONVERGE — same applied index, identical state, the installed
+    peer included (chaos/invariants.py check_convergence); this is the
+    check log matching cannot give once the log below a floor is gone.
+    """
+
+    def __init__(self, plan: NodeChaosPlan, tmpdir: str, peers: int = 3):
+        cfg = RaftConfig(num_groups=2, num_peers=peers, log_window=16,
+                         max_entries_per_msg=4, election_ticks=10,
+                         heartbeat_ticks=1, tick_interval_s=0.0)
+        super().__init__(plan, tmpdir, cfg=cfg, peers=peers)
+        G = self.cfg.num_groups
+        self._sm: List[List[Dict[str, str]]] = [
+            [dict() for _ in range(G)] for _ in range(peers)]
+        self._sm_applied = np.zeros((peers, G), np.int64)
+        self.report.update({"snapshots_installed": 0,
+                            "snapshots_sent": 0, "compactions": 0})
+
+    def _boot(self, p: int) -> RaftNode:
+        n = super()._boot(p)
+        n.snapshot_provider = lambda g, p=p: self._provide(p, g)
+        n.snapshot_installer = \
+            lambda g, idx, blob, p=p: self._install(p, g, idx, blob)
+        return n
+
+    def _on_replay(self, p: int, replayed, node: RaftNode) -> None:
+        # The crash took the SM with it (these dicts ARE the apply
+        # plane): rebuild from the replay stream, exactly as RaftDB's
+        # delete-and-replay does (reference db.go:27-29).
+        G = self.cfg.num_groups
+        self._sm[p] = [dict() for _ in range(G)]
+        self._sm_applied[p] = 0
+        for (g, idx) in sorted(replayed):
+            self._apply_sm(p, g, idx, replayed[(g, idx)])
+
+    def _apply_commit(self, p: int, g: int, idx: int, sql: str) -> None:
+        self._apply_sm(p, g, idx, sql)
+
+    def _apply_sm(self, p: int, g: int, idx: int, sql: str) -> None:
+        parts = sql.split(" ")
+        if len(parts) == 3 and parts[0] == "SET":
+            self._sm[p][g][parts[1]] = parts[2]
+        if idx > self._sm_applied[p, g]:
+            self._sm_applied[p, g] = idx
+
+    def _provide(self, p: int, g: int):
+        blob = json.dumps(sorted(self._sm[p][g].items())).encode()
+        return int(self._sm_applied[p, g]), blob
+
+    def _install(self, p: int, g: int, idx: int, blob: bytes) -> None:
+        self._sm[p][g] = dict(json.loads(blob.decode()))
+        self._sm_applied[p, g] = idx
+        self.report["snapshots_installed"] += 1
+
+    def _post_tick(self, t: int, healing: bool) -> None:
+        ce = self.plan.compact_every
+        if not ce or not t or t % ce:
+            return
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            applied = {g: int(self._sm_applied[p, g])
+                       for g in range(self.cfg.num_groups)}
+            if n.compact(applied, keep=self.plan.compact_keep):
+                self.report["compactions"] += 1
+
+    def _final_check(self) -> None:
+        self.report["snapshots_sent"] = sum(
+            n.metrics.snapshots_sent for n in self.nodes
+            if n is not None)
+        for g in range(self.cfg.num_groups):
+            survivors = [(p, int(self._sm_applied[p, g]), self._sm[p][g])
+                         for p, n in enumerate(self.nodes)
+                         if n is not None]
+            check_convergence(g, survivors, context="post-heal")
+
+
+def _free_ports(n: int) -> List[int]:
+    """n OS-assigned localhost ports (bind-and-release; the runs bind
+    them back immediately, and a collision fails loudly on bind)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TcpClusterChaosRunner:
+    """Chaos under the REAL TCP transport (transport/tcp.py).
+
+    P RaftNodes ticked manually, but their frames cross actual
+    localhost sockets through each transport's SendFaults seam: seeded
+    send-side drops, ONE-directional blocks (asymmetric partition),
+    frame corruption (the receiver's CRC framing must drop + count
+    every mangled frame and keep its recv loop alive), and delayed
+    frames (out-of-order arrival).  Kernel scheduling orders delivery,
+    so this plane is NOT bit-reproducible — the schedule is
+    deterministic from the seed and the invariants (election safety,
+    commit monotonicity, cross-node log matching of the published
+    streams) must hold on every run, which is exactly the guarantee a
+    real deployment gets.  After the heal window the cluster must have
+    made real progress (commits floor asserted by callers).
+    """
+
+    def __init__(self, plan: TcpChaosPlan, tmpdir: str, peers: int = 3):
+        self.plan = plan
+        self.tmpdir = tmpdir
+        self.P = peers
+        self.cfg = RaftConfig(
+            num_groups=2, num_peers=peers, log_window=64,
+            max_entries_per_msg=4, election_ticks=10, heartbeat_ticks=1,
+            tick_interval_s=0.0)
+        self.nodes: List[Optional[RaftNode]] = [None] * peers
+        self.safety = ElectionSafety(LEADER)
+        self.monotonic = CommitMonotonic(peers, self.cfg.num_groups)
+        self._hist: Dict[Tuple[int, int], str] = {}
+        self.report = {"commits": 0, "sent_dropped": 0,
+                       "sent_corrupted": 0, "sent_delayed": 0,
+                       "corrupt_frames_dropped": 0, "asym_partitions": 0}
+
+    def _drain_live(self) -> None:
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            while True:
+                try:
+                    item = n.commit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None or item is CLOSED:
+                    continue
+                for (g, idx, sql) in _expand_commit_item(item, n):
+                    prev = self._hist.setdefault((g, idx), sql)
+                    if prev != sql:
+                        raise InvariantViolation(
+                            f"log matching: node {p} committed g{g} "
+                            f"i{idx} {sql!r} but {prev!r} was committed")
+                    self.report["commits"] += 1
+
+    def _observe(self, t: int) -> None:
+        G = self.cfg.num_groups
+        roles = np.full((self.P, G), DEAD_ROLE, np.int64)
+        terms = np.zeros((self.P, G), np.int64)
+        commits = np.zeros((self.P, G), np.int64)
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            roles[p] = n._last_role
+            terms[p] = n._hard_np[:, 0]
+            commits[p] = n._hard_np[:, 2]
+        self.safety.observe(t, roles, terms)
+        commits = np.maximum(commits, self.monotonic._hi * (roles < 0))
+        self.monotonic.observe(t, commits)
+
+    def run(self) -> dict:
+        ports = _free_ports(self.P)
+        urls = [f"127.0.0.1:{port}" for port in ports]
+        faults = [SendFaults(self.plan.seed * 131 + p)
+                  for p in range(self.P)]
+        rng = np.random.default_rng(self.plan.seed + 1)
+        try:
+            for p in range(self.P):
+                tr = TcpTransport(urls, p)
+                tr.faults = faults[p]
+                n = RaftNode(p + 1, self.P, self.cfg, tr,
+                             os.path.join(self.tmpdir,
+                                          f"tcp-node-{p + 1}"))
+                n.start(threaded=False)
+                self.nodes[p] = n
+            total = self.plan.ticks + self.plan.heal_ticks
+            for t in range(total):
+                healing = t >= self.plan.ticks
+                for p, f in enumerate(faults):
+                    f.heal()
+                    drop = corrupt = delay = dsec = 0.0
+                    if not healing:
+                        for w in self.plan.drops:
+                            if w.start <= t < w.end:
+                                drop = w.p
+                        for w in self.plan.corruptions:
+                            if w.start <= t < w.end:
+                                corrupt = w.p
+                        for w in self.plan.delays:
+                            if w.start <= t < w.end:
+                                delay = w.p
+                                dsec = w.latency / 1000.0
+                        for w in self.plan.asym_partitions:
+                            if w.start <= t < w.end and p == w.src:
+                                f.block(w.dst + 1)
+                                if t == w.start:
+                                    self.report["asym_partitions"] += 1
+                    f.set_rates(drop, corrupt, delay, dsec)
+                if not healing and rng.random() < self.plan.prop_rate:
+                    g = int(rng.integers(0, self.cfg.num_groups))
+                    src = int(rng.integers(0, self.P))
+                    self.nodes[src].propose(g, f"SET k{g} v{t}".encode())
+                for n in self.nodes:
+                    n.tick()
+                # Let frames cross the sockets before the next tick:
+                # the recv threads stage asynchronously.
+                time.sleep(0.002)
+                self._drain_live()
+                self._observe(t)
+        finally:
+            for n in self.nodes:
+                if n is not None:
+                    n.stop()
+        self.report["sent_dropped"] = sum(f.dropped for f in faults)
+        self.report["sent_corrupted"] = sum(f.corrupted for f in faults)
+        self.report["sent_delayed"] = sum(f.delayed for f in faults)
+        self.report["corrupt_frames_dropped"] = sum(
+            n.metrics.faults_corrupt_frames for n in self.nodes
+            if n is not None)
         return {"plan_digest": self.plan.digest(), **self.report}

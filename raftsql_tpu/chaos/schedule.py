@@ -56,6 +56,65 @@ class PartitionWindow:
 
 
 @dataclasses.dataclass(frozen=True)
+class AsymPartitionWindow:
+    """ONE-directional partition for the window: `dst` stops hearing
+    `src` while `src` still hears `dst` (transport.faults.asym_partition
+    on the device plane; FaultPlan.block / TCP SendFaults.block on the
+    wire planes).  src == LEADER_TARGET resolves to group 0's leader at
+    `start` — "the cluster goes deaf to its leader" is the classic
+    half-open failure."""
+    start: int
+    end: int
+    src: int
+    dst: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SkewWindow:
+    """Per-peer clock skew: while start <= tick < end, peer p's
+    election/heartbeat timers advance incs[p] intervals per tick
+    (1 = nominal).  Integer rates express relative drift — a peer at 2
+    experiences time twice as fast as its cluster; real deployments
+    never tick in lockstep, and the batched runtime's lockstep default
+    is exactly the assumption this window breaks."""
+    start: int
+    end: int
+    incs: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class EnospcFault:
+    """Peer `peer`'s op-th WAL write ATTEMPT fails with ENOSPC before
+    any byte lands (storage/fsio.py check_write).  The runner treats it
+    as fatal — crash + restart — and the consumed trigger models the
+    operator freeing space, so the retry succeeds from a clean tail."""
+    peer: int
+    op: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FsyncStall:
+    """Peer `peer`'s fsyncs op .. op+count-1 stall `stall_s` seconds
+    each (slow disk, not failed disk): durability holds, latency
+    suffers, every invariant must survive the slowdown."""
+    peer: int
+    op: int
+    count: int = 3
+    stall_s: float = 0.02
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptWindow:
+    """Wire-frame corruption (loopback / TCP planes): while active,
+    each encoded frame is bit-flipped with probability p.  The CRC32
+    framing (transport/codec.py) must catch and drop every mangled
+    frame — corruption may cost progress, never correctness."""
+    start: int
+    end: int
+    p: float
+
+
+@dataclasses.dataclass(frozen=True)
 class CrashEvent:
     """Hard process crash at `tick` (the whole fused cluster process),
     followed by immediate restart-from-WAL.  power_loss=True models a
@@ -100,6 +159,17 @@ class ChaosSchedule:
     crashes: Tuple[CrashEvent, ...] = ()
     fsync_faults: Tuple[FsyncFault, ...] = ()
     torn_writes: Tuple[TornWriteFault, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    skews: Tuple[SkewWindow, ...] = ()
+    enospc_faults: Tuple[EnospcFault, ...] = ()
+    fsync_stalls: Tuple[FsyncStall, ...] = ()
+    # Aggressive-compaction interleaving: every `compact_every` ticks the
+    # runner advances every peer's compaction floor to applied -
+    # compact_keep (clamped to the device window) — so crashes and
+    # restarts land on compacted WALs (COMPACT markers, segment drops,
+    # floor-aware replay).  0 = never compact (the pre-matrix default).
+    compact_every: int = 0
+    compact_keep: int = 0
     prop_rate: float = 0.5       # P(issue a PUT batch) per tick
     read_rate: float = 0.35      # P(issue a linearizable GET) per tick
 
@@ -128,7 +198,41 @@ class NodeChaosPlan:
     ticks: int
     partitions: Tuple[PartitionWindow, ...] = ()
     crashes: Tuple[NodeCrash, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    skews: Tuple[SkewWindow, ...] = ()
+    corruptions: Tuple[CorruptWindow, ...] = ()
+    # Snapshot-interleaving knobs (SnapshotChaosRunner): aggressive
+    # per-node compaction cadence, retained window, and a fault-free
+    # heal window at the end of the run over which survivors must
+    # CONVERGE (the post-snapshot convergence invariant).
+    compact_every: int = 0
+    compact_keep: int = 0
+    heal_ticks: int = 0
     prop_rate: float = 0.4
+
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TcpChaosPlan:
+    """Scripted scenario for a RaftNode cluster over the REAL TCP
+    transport (transport/tcp.py + its SendFaults seam).  Frames cross
+    actual localhost sockets, so arrival interleaving is kernel-
+    scheduled: the SCHEDULE is deterministic from the seed, the
+    invariants must hold on every run, but the committed history is not
+    bit-reproducible (documented in the README fault matrix — this is
+    the one plane where a virtual clock does not exist)."""
+    seed: int
+    ticks: int
+    drops: Tuple[DropWindow, ...] = ()
+    corruptions: Tuple[CorruptWindow, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    delays: Tuple[DelayWindow, ...] = ()       # latency in ms units
+    heal_ticks: int = 60
+    prop_rate: float = 0.5
 
     def digest(self) -> str:
         blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
@@ -199,6 +303,182 @@ def generate(seed: int, ticks: int = 240, peers: int = 3,
                          delays=delays, partitions=tuple(parts),
                          crashes=crashes, fsync_faults=faults,
                          torn_writes=torn)
+
+
+# ---------------------------------------------------------------------------
+# Scenario FAMILY generators — one per uncovered fault-matrix axis
+# (ROADMAP open items).  Each derives a focused schedule from one seed:
+# the family's faults plus light background load, sized so a fast
+# tier-1 run stays cheap and `make chaos-matrix` can sweep one seed per
+# family.  All are deterministic functions of (seed, ticks).
+
+def generate_asym(seed: int, ticks: int = 160,
+                  peers: int = 3) -> ChaosSchedule:
+    """Asymmetric partitions (fused plane): one leader-targeted deafness
+    window (the cluster stops hearing its leader), one random
+    one-directional link cut, plus a crash so recovery interleaves."""
+    rng = np.random.default_rng(seed ^ 0xA51)
+    warmup = 40
+    s0 = int(rng.integers(warmup, ticks // 2))
+    d0 = int(rng.integers(0, peers))
+    asym = [AsymPartitionWindow(s0, s0 + int(rng.integers(25, 40)),
+                                LEADER_TARGET, d0)]
+    s1 = int(rng.integers(ticks // 2, ticks - 30))
+    src = int(rng.integers(0, peers))
+    dst = int((src + 1 + rng.integers(0, peers - 1)) % peers)
+    asym.append(AsymPartitionWindow(s1, s1 + int(rng.integers(20, 35)),
+                                    src, dst))
+    crash = CrashEvent(int(rng.integers(int(ticks * 0.55),
+                                        int(ticks * 0.85))))
+    return ChaosSchedule(seed=seed, ticks=ticks,
+                         asym_partitions=tuple(asym), crashes=(crash,))
+
+
+def generate_skew(seed: int, ticks: int = 160, peers: int = 3,
+                  max_inc: int = 3) -> ChaosSchedule:
+    """Per-peer clock skew (fused plane): two windows of drifting timer
+    rates — one peer fast, later another — with a crash between them.
+    The lockstep run of the SAME seed minus the skews is the regression
+    baseline: election outcomes must demonstrably differ."""
+    rng = np.random.default_rng(seed ^ 0x5E3)
+    warmup = 30
+
+    def draw_incs() -> Tuple[int, ...]:
+        incs = [1] * peers
+        fast = int(rng.integers(0, peers))
+        incs[fast] = int(rng.integers(2, max_inc + 1))
+        slow = int((fast + 1) % peers)
+        if rng.random() < 0.5:
+            incs[slow] = 0               # a stalled clock, not just slow
+        return tuple(incs)
+
+    s0 = int(rng.integers(warmup, ticks // 3))
+    w0 = SkewWindow(s0, s0 + int(rng.integers(30, 50)), draw_incs())
+    s1 = int(rng.integers(ticks // 2, int(ticks * 0.8)))
+    w1 = SkewWindow(s1, s1 + int(rng.integers(25, 40)), draw_incs())
+    crash = CrashEvent(int(rng.integers(ticks // 3, ticks // 2)))
+    return ChaosSchedule(seed=seed, ticks=ticks, skews=(w0, w1),
+                         crashes=(crash,))
+
+
+def generate_enospc(seed: int, ticks: int = 140,
+                    peers: int = 3) -> ChaosSchedule:
+    """Disk-full on WAL append (fused plane): two ENOSPC write failures
+    on seeded peers/ops — each is fatal (crash + restart from a clean
+    tail), and the consumed trigger lets the retry land."""
+    rng = np.random.default_rng(seed ^ 0xE05)
+    faults = tuple(EnospcFault(int(rng.integers(0, peers)),
+                               int(rng.integers(20, 60)) + 60 * i)
+                   for i in range(2))
+    return ChaosSchedule(seed=seed, ticks=ticks, enospc_faults=faults,
+                         prop_rate=0.6)
+
+
+def generate_stall(seed: int, ticks: int = 120,
+                   peers: int = 3) -> ChaosSchedule:
+    """Fsync latency stalls (fused plane): bursts of slow fsyncs on two
+    seeded peers, plus a crash mid-run — durability and ordering must
+    hold when the barrier is merely LATE."""
+    rng = np.random.default_rng(seed ^ 0x57A)
+    stalls = tuple(FsyncStall(int(rng.integers(0, peers)),
+                              int(rng.integers(10, 40)) + 40 * i,
+                              count=3, stall_s=0.02)
+                   for i in range(2))
+    crash = CrashEvent(int(rng.integers(ticks // 2, int(ticks * 0.8))))
+    return ChaosSchedule(seed=seed, ticks=ticks, fsync_stalls=stalls,
+                         crashes=(crash,), prop_rate=0.6)
+
+
+def generate_compact(seed: int, ticks: int = 200,
+                     peers: int = 3) -> ChaosSchedule:
+    """Aggressive compaction interleaved with crashes (fused plane):
+    compact every few ticks with a tiny retained window while crashes
+    (one power loss with a torn record) land between floors — restart
+    replays COMPACT-marked, segment-dropped WALs.  Pair with a small
+    cfg log_window (the runner's compact clamps keep to it)."""
+    rng = np.random.default_rng(seed ^ 0xC04)
+    lo, hi = int(ticks * 0.3), int(ticks * 0.9)
+    t0, t1 = sorted(int(t) for t in rng.choice(
+        np.arange(lo, hi), size=2, replace=False))
+    crashes = (CrashEvent(t0), CrashEvent(t1))
+    # A mid-record power loss (NOT on a tick boundary — boundary
+    # crashes have nothing unsynced to tear) so torn-tail repair runs
+    # against a compacted, COMPACT-marked WAL.
+    torn = (TornWriteFault(int(rng.integers(0, peers)),
+                           int(rng.integers(120, 240))),)
+    return ChaosSchedule(seed=seed, ticks=ticks, crashes=crashes,
+                         torn_writes=torn,
+                         compact_every=int(rng.integers(6, 12)),
+                         compact_keep=1, prop_rate=0.9, read_rate=0.3)
+
+
+def generate_corrupt_plan(seed: int, ticks: int = 260,
+                          peers: int = 3) -> NodeChaosPlan:
+    """Byzantine/corrupted payloads (lockstep wire plane): windows of
+    seeded frame corruption — the CRC framing must drop every mangled
+    frame (counted), and consensus must ride out the loss."""
+    rng = np.random.default_rng(seed ^ 0xC0F)
+    warmup = 50
+    wins = []
+    for i in range(2):
+        s = int(rng.integers(warmup + i * ticks // 3,
+                             warmup + 20 + i * ticks // 3))
+        wins.append(CorruptWindow(s, s + int(rng.integers(30, 50)),
+                                  float(rng.uniform(0.15, 0.4))))
+    c0 = int(rng.integers(ticks // 2, int(ticks * 0.8)))
+    return NodeChaosPlan(seed=seed, ticks=ticks,
+                         corruptions=tuple(wins),
+                         crashes=(NodeCrash(c0, int(rng.integers(0, peers)),
+                                            down=int(rng.integers(25, 40))),))
+
+
+def generate_snapshot_plan(seed: int, ticks: int = 340,
+                           peers: int = 3) -> NodeChaosPlan:
+    """Aggressive compaction + InstallSnapshot + crash interleaving
+    (lockstep RaftNode plane): every node compacts on a short cadence
+    while one follower is crashed long enough to fall below every
+    retained floor — its restart must be served by a full state
+    transfer, and a second, leader-targeted crash lands while transfers
+    are in flight.  After a fault-free heal window the survivors must
+    CONVERGE (identical applied state per group)."""
+    rng = np.random.default_rng(seed ^ 0x5A7)
+    lag_peer = int(rng.integers(0, peers))
+    c0 = int(rng.integers(50, 70))
+    down = int(rng.integers(150, 190))
+    c1 = int(rng.integers(c0 + down + 20, ticks - 40))
+    crashes = (NodeCrash(c0, lag_peer, down=down),
+               NodeCrash(c1, LEADER_TARGET, down=int(rng.integers(20, 30))))
+    return NodeChaosPlan(seed=seed, ticks=ticks, crashes=crashes,
+                         compact_every=int(rng.integers(6, 10)),
+                         compact_keep=1, heal_ticks=80, prop_rate=0.95)
+
+
+def generate_tcp_plan(seed: int, ticks: int = 200,
+                      peers: int = 3) -> TcpChaosPlan:
+    """Chaos under the REAL TCP transport: seeded send-side drops, a
+    one-directional (asymmetric) block window, frame corruption, and
+    delayed frames — followed by a heal window over which the cluster
+    must converge and commit."""
+    rng = np.random.default_rng(seed ^ 0x7C9)
+    warmup = 40
+    s0 = int(rng.integers(warmup, ticks // 3))
+    drops = (DropWindow(s0, s0 + int(rng.integers(25, 40)),
+                        float(rng.uniform(0.1, 0.25))),)
+    s1 = int(rng.integers(ticks // 3, 2 * ticks // 3))
+    corr = (CorruptWindow(s1, s1 + int(rng.integers(30, 45)),
+                          float(rng.uniform(0.2, 0.4))),)
+    src = int(rng.integers(0, peers))
+    dst = int((src + 1 + rng.integers(0, peers - 1)) % peers)
+    s2 = int(rng.integers(2 * ticks // 3, ticks - 25))
+    asym = (AsymPartitionWindow(s2, s2 + int(rng.integers(20, 30)),
+                                src, dst),)
+    d0 = int(rng.integers(warmup, ticks - 40))
+    delays = (DelayWindow(d0, d0 + int(rng.integers(20, 35)),
+                          float(rng.uniform(0.1, 0.25)),
+                          int(rng.integers(5, 15))),)   # milliseconds
+    return TcpChaosPlan(seed=seed, ticks=ticks, drops=drops,
+                        corruptions=corr, asym_partitions=asym,
+                        delays=delays)
 
 
 def generate_node_plan(seed: int, ticks: int = 320,

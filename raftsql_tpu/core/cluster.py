@@ -51,7 +51,7 @@ def deliver(outbox: Outbox) -> Inbox:
 
 
 def cluster_step(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
-                 prop_n: jax.Array
+                 prop_n: jax.Array, timer_inc: jax.Array | int = 1
                  ) -> Tuple[PeerState, Inbox, StepInfo]:
     """One tick for the whole co-located cluster.
 
@@ -60,25 +60,36 @@ def cluster_step(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
       inboxes: stacked Inbox, leaves [P, G, P, ...].
       prop_n: [P, G] i32 — proposals submitted at each peer this tick (only
         the leader's are accepted; host routes via leader_hint).
+      timer_inc: scalar (lockstep) or [P] i32 — PER-PEER election/
+        heartbeat timer advance this step.  Real deployments never tick
+        in lockstep; a [P] vector lets peers drift (chaos clock-skew
+        schedules, and any future per-peer pacing).  Each peer's scalar
+        reaches core/step.py's timer_inc unchanged, so timer semantics
+        per peer are identical to the distributed runtime's.
 
     Returns:
       (new_states, delivered_inboxes_for_next_tick, stacked_infos).
     """
     self_ids = jnp.arange(cfg.num_peers, dtype=I32)
-    step = jax.vmap(functools.partial(peer_step, cfg))
-    new_states, outboxes, infos = step(states, inboxes, prop_n, self_ids)
+    ti = jnp.broadcast_to(jnp.asarray(timer_inc, I32), (cfg.num_peers,))
+
+    def _one(st, ib, pn, sid, t):
+        return peer_step(cfg, st, ib, pn, sid, timer_inc=t)
+
+    new_states, outboxes, infos = jax.vmap(_one)(states, inboxes, prop_n,
+                                                 self_ids, ti)
     return new_states, deliver(outboxes), infos
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
 def cluster_step_jit(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
-                     prop_n: jax.Array):
-    return cluster_step(cfg, states, inboxes, prop_n)
+                     prop_n: jax.Array, timer_inc: jax.Array | int = 1):
+    return cluster_step(cfg, states, inboxes, prop_n, timer_inc)
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
 def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
-                      prop_n: jax.Array):
+                      prop_n: jax.Array, timer_inc: jax.Array | int = 1):
     """Fused step for the DURABLE co-located runtime (runtime/fused.py):
     messages stay on device (the delivered inboxes are returned as
     opaque carry), and the host-facing StepInfo crosses as ONE packed
@@ -94,7 +105,7 @@ def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
     count, so a settled cluster still parks."""
     from raftsql_tpu.config import MSG_REQ, MSG_RESP
 
-    st, ib, infos = cluster_step(cfg, states, inboxes, prop_n)
+    st, ib, infos = cluster_step(cfg, states, inboxes, prop_n, timer_inc)
     busy = (jnp.any(ib.v_type != 0)
             | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
             | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))
@@ -103,7 +114,8 @@ def cluster_step_host(cfg: RaftConfig, states: PeerState, inboxes: Inbox,
 
 @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))
 def cluster_multistep_host(cfg: RaftConfig, states: PeerState,
-                           inboxes: Inbox, steps: int, prop_n: jax.Array):
+                           inboxes: Inbox, steps: int, prop_n: jax.Array,
+                           timer_inc: jax.Array | int = 1):
     """`steps` fused steps in ONE dispatch, for the co-located durable
     runtime (runtime/fused.py steps_per_dispatch): device dispatch
     overhead — the dominant per-tick cost through a remote-device
@@ -127,7 +139,7 @@ def cluster_multistep_host(cfg: RaftConfig, states: PeerState,
 
     def body(carry, prop_t):
         st, ib = carry
-        st, ib, info = cluster_step(cfg, st, ib, prop_t)
+        st, ib, info = cluster_step(cfg, st, ib, prop_t, timer_inc)
         busy_s = (jnp.any(ib.v_type != 0)
                   | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
                   | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))

@@ -217,6 +217,13 @@ class FusedClusterNode:
         self._pub_thread = threading.Thread(
             target=self._pub_run, daemon=True, name="fused-publish")
         self._pub_thread.start()
+        # Per-peer timer skew seam: None = lockstep (every peer's timers
+        # advance 1 per step).  A [P] i32 array makes peers drift — the
+        # chaos harness's clock-skew schedules set it, modeling the real
+        # world where deployments never tick in lockstep.  Applied on
+        # the next tick(); plumbed through cluster_step's per-peer
+        # timer_inc (core/cluster.py).
+        self.timer_inc: Optional[np.ndarray] = None
         # Native payload plane (native/wal.cc): combined WAL+payload-log
         # C calls, OPT-IN via RAFTSQL_FUSED_NATIVE_PLOG=1.  Measured on
         # the Python-consumer stack it LOSES to the columnar Python
@@ -539,19 +546,23 @@ class FusedClusterNode:
         self._hard[p][changed] = hs[changed]
         return True
 
-    def _device_step(self, prop_n: np.ndarray):
+    def _device_step(self, prop_n: np.ndarray,
+                     timer_inc: Optional[np.ndarray] = None):
         """Dispatch one cluster step; returns (packed-info device array,
         device busy bit or None).  MeshClusterNode overrides this with
         the shard_map'd step — the durable host plane below is identical
-        either way."""
+        either way.  `timer_inc` is the per-peer [P] timer advance
+        (None = lockstep 1s, the steady-state fast path)."""
+        ti = 1 if timer_inc is None \
+            else jnp.asarray(np.asarray(timer_inc, np.int32))
         if self._steps > 1:
             self.states, self.inboxes, pinfos_dev, busy = \
                 cluster_multistep_host(self.cfg, self.states,
                                        self.inboxes, self._steps,
-                                       jnp.asarray(prop_n))
+                                       jnp.asarray(prop_n), ti)
             return pinfos_dev, busy
         self.states, self.inboxes, pinfo_dev, busy = cluster_step_host(
-            self.cfg, self.states, self.inboxes, jnp.asarray(prop_n))
+            self.cfg, self.states, self.inboxes, jnp.asarray(prop_n), ti)
         return pinfo_dev, busy
 
     def tick(self) -> None:
@@ -569,7 +580,13 @@ class FusedClusterNode:
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n(self._steps)
-        pinfo_dev, busy_dev = self._device_step(prop_n)
+        ti = self.timer_inc
+        if ti is not None:
+            # Skew accounting: how far this tick's timer advances
+            # deviate from lockstep, per peer, summed.
+            self.metrics.faults_skew_ticks += int(
+                np.abs(np.asarray(ti, np.int64) - 1).sum())
+        pinfo_dev, busy_dev = self._device_step(prop_n, ti)
         t1 = _t.monotonic()
         # Overlap: tick t-1's commits are durable (fsynced last tick).
         # Parallel hosts hand them to the publisher worker (the apply
@@ -1128,7 +1145,15 @@ class MeshClusterNode(FusedClusterNode):
         self.states, self.inboxes = shard_cluster_arrays(
             mesh, self.states, self.inboxes)
 
-    def _device_step(self, prop_n: np.ndarray):
+    def _device_step(self, prop_n: np.ndarray,
+                     timer_inc: Optional[np.ndarray] = None):
+        if timer_inc is not None:
+            # The shard_map'd step has no per-peer timer plumbing; the
+            # mesh runtime ticks lockstep only.  Fail loudly rather
+            # than silently ignoring a requested skew.
+            raise NotImplementedError(
+                "per-peer timer skew is not supported on the mesh "
+                "runtime (lockstep ticking only)")
         self.states, self.inboxes, pinfo_dev = self._sharded_step(
             self.states, self.inboxes, jnp.asarray(prop_n))
         return pinfo_dev, None      # mesh runtime: manual ticking only

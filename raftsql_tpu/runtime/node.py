@@ -289,6 +289,11 @@ class RaftNode:
                     self.commit_q.put((g, gl.start + 1 + i, sql))
         self._replay_groups = {}
         self.commit_q.put(None)         # replay-complete sentinel
+        # Adopt the transport's fault counters into this node's metrics
+        # (transports that count — TcpTransport's corrupt-frame drops —
+        # carry a `metrics` attribute; /metrics then reports them).
+        if hasattr(self.transport, "metrics"):
+            self.transport.metrics = self.metrics
         self.transport.start(self.node_id, self._deliver, self._on_error)
         if threaded:
             self._thread = threading.Thread(target=self._run, daemon=True,

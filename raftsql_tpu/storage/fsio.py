@@ -23,6 +23,17 @@ Fault classes (the chaos harness's storage axis):
     power-loss simulation can truncate files to exactly what a real
     crash could leave: everything synced, plus at most a torn prefix of
     one unsynced record (WAL._repair_tail's job to repair).
+  * ENOSPC — the Nth write ATTEMPT matching a rule raises EnospcError
+    (errno ENOSPC) BEFORE any byte reaches the file: the WAL record is
+    refused whole, so the log tail stays a clean record boundary
+    instead of a half-written frame.  The trigger is consumed when it
+    fires (an operator freeing disk space), so a crash+restart retry
+    of the same record succeeds.
+  * FSYNC STALL — the Nth..(N+count-1)th fsyncs matching a rule sleep
+    `stall_s` before completing (a saturated disk queue, not a failed
+    one): data IS durable afterwards, just late — the tick slows, no
+    invariant may break, and the stall count is exported so slow-disk
+    incidents are visible in /metrics.
 
 The injector also keeps an ordered event log (("write"|"fsync"|
 "fsync_dir", path) tuples) so tests can assert durability ORDERING —
@@ -38,13 +49,24 @@ on-disk format.
 """
 from __future__ import annotations
 
+import errno
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 
 class FsyncFaultError(OSError):
     """Injected fsync failure (distinguishable from real OS errors)."""
+
+
+class EnospcError(OSError):
+    """Injected disk-full write failure: raised BEFORE the write lands,
+    so the refused record never reaches the file and the log tail stays
+    a clean record boundary.  Carries errno.ENOSPC like the real one."""
+
+    def __init__(self, msg: str):
+        super().__init__(errno.ENOSPC, msg)
 
 
 class CrashPointError(RuntimeError):
@@ -64,16 +86,24 @@ class _FsyncRule:
     fsyncs and writes it sees, fails/skips/crashes at chosen ops."""
 
     def __init__(self, substring: str, fail_at=(), silent_from=None,
-                 crash_write_at=(), tag=None):
+                 crash_write_at=(), tag=None, enospc_write_at=(),
+                 stall_at=(), stall_s: float = 0.05):
         self.substring = substring
         self.fail_at = set(fail_at)
         self.silent_from = silent_from
         self.crash_write_at = set(crash_write_at)
         self.tag = tag
+        # ENOSPC triggers fire on the (write_ops + 1)th write ATTEMPT
+        # and are consumed when they fire (see module doc).
+        self.enospc_write_at = set(enospc_write_at)
+        self.stall_at = set(stall_at)
+        self.stall_s = stall_s
         self.ops = 0
         self.write_ops = 0
         self.failures = 0
         self.lost = 0
+        self.enospc_hits = 0
+        self.stalls = 0
 
     def matches(self, path: str) -> bool:
         return self.substring in path + os.sep
@@ -91,6 +121,8 @@ class StorageFaultInjector:
         self.fsync_ops = 0
         self.write_ops = 0
         self.fsync_failures = 0
+        self.enospc_hits = 0
+        self.fsync_stalls = 0
         self.events: List[Tuple[str, str]] = []
         # path -> (offset before last write, bytes written) for torn-
         # write crash simulation.
@@ -102,14 +134,36 @@ class StorageFaultInjector:
 
     def add_rule(self, substring: str, fail_at=(),
                  silent_from: Optional[int] = None,
-                 crash_write_at=(), tag=None) -> _FsyncRule:
+                 crash_write_at=(), tag=None, enospc_write_at=(),
+                 stall_at=(), stall_s: float = 0.05) -> _FsyncRule:
         rule = _FsyncRule(substring, fail_at, silent_from,
-                          crash_write_at, tag)
+                          crash_write_at, tag, enospc_write_at,
+                          stall_at, stall_s)
         with self._lock:
             self.rules.append(rule)
         return rule
 
     # -- hooks called by the I/O functions below -----------------------
+
+    def check_write(self, path: str, nbytes: int) -> None:
+        """Pre-write gate: raises EnospcError when a rule's next write
+        attempt is scheduled to hit disk-full.  Runs BEFORE the caller
+        writes anything, so the refused record never lands (the log
+        tail cannot be corrupted by a half-written frame).  The trigger
+        is consumed so a post-restart retry of the same record
+        succeeds — the disk-was-freed recovery story."""
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(path):
+                    continue
+                attempt = rule.write_ops + 1
+                if attempt in rule.enospc_write_at:
+                    rule.enospc_write_at.discard(attempt)
+                    rule.enospc_hits += 1
+                    self.enospc_hits += 1
+                    raise EnospcError(
+                        f"injected ENOSPC (write attempt {attempt} of "
+                        f"rule {rule.substring!r}) on {path}")
 
     def on_write(self, path: str, offset: int, nbytes: int) -> None:
         """Record one (already page-cache-visible) write; raises
@@ -132,7 +186,10 @@ class StorageFaultInjector:
 
     def on_fsync(self, path: str, size: int, kind: str = "fsync") -> bool:
         """Count one fsync; returns False when the sync must be
-        silently skipped; raises FsyncFaultError for a failed one."""
+        silently skipped; raises FsyncFaultError for a failed one.
+        Stall rules sleep OUTSIDE the lock (a stalled disk must slow
+        this fsync, not serialize every other peer's)."""
+        stall_for = 0.0
         with self._lock:
             self.fsync_ops += 1
             self.events.append((kind, path))
@@ -147,15 +204,19 @@ class StorageFaultInjector:
                     raise FsyncFaultError(
                         f"injected fsync failure (op {rule.ops} of rule "
                         f"{rule.substring!r}) on {path}")
+                if rule.ops in rule.stall_at:
+                    rule.stalls += 1
+                    self.fsync_stalls += 1
+                    stall_for = max(stall_for, rule.stall_s)
                 if rule.silent_from is not None \
                         and rule.ops >= rule.silent_from:
                     rule.lost += 1
                     silent = True
-            if silent:
-                return False
-            if kind == "fsync":
+            if not silent and kind == "fsync":
                 self.synced_size[path] = size
-            return True
+        if stall_for > 0.0:
+            time.sleep(stall_for)
+        return not silent
 
     # -- crash simulation ----------------------------------------------
 
@@ -242,15 +303,19 @@ def write(f, data: bytes) -> None:
     crash-point check runs — page-cache semantics: a process kill keeps
     what was written, a power loss keeps at most a torn prefix of it
     (the injector's tear/drop helpers cut it back to what a real crash
-    could leave)."""
+    could leave).  An ENOSPC rule fires BEFORE any byte lands (see
+    StorageFaultInjector.check_write): the caller's record is refused
+    whole and the file tail is untouched."""
     inj = _injector
     if inj is None:
         f.write(data)
         return
+    path = getattr(f, "name", "")
+    inj.check_write(path, len(data))     # may raise EnospcError
     offset = f.tell()
     f.write(data)
     f.flush()
-    inj.on_write(getattr(f, "name", ""), offset, len(data))
+    inj.on_write(path, offset, len(data))
 
 
 def fsync_file(f) -> None:

@@ -258,6 +258,7 @@ class WAL:
         self._h = None
         self._f = None
         self._pending = False
+        self.last_sync_s = 0.0
         # A crash can tear the active segment's tail.  Appending AFTER
         # torn bytes would hide every later record from replay (it stops
         # at the first bad CRC) — durably-acked writes would vanish on the
@@ -318,7 +319,13 @@ class WAL:
     def _write(self, body: bytes) -> None:
         # One write per record (not header-then-body): the fsio seam
         # records it whole, so a simulated torn write tears a RECORD —
-        # the shape a real power loss leaves.
+        # the shape a real power loss leaves.  A write failure (ENOSPC
+        # through fsio.check_write) raises BEFORE any byte lands and
+        # BEFORE _pending/_bytes advance, so the refused record leaves
+        # the file tail at a clean record boundary and the in-memory
+        # bookkeeping matched to it — the caller surfaces the error
+        # (the runtimes treat it as fatal, like a failed fsync) and a
+        # restart replays a consistent log.
         fsio.write(self._f, _HDR.pack(zlib.crc32(body), len(body)) + body)
         self._pending = True
         self._bytes += _HDR.size + len(body)
@@ -607,13 +614,21 @@ class WAL:
         self._write_compact_rec(group, index, term)
 
     def sync(self) -> None:
+        """Durable barrier.  May stall (slow disk — the fsio seam's
+        stall rules model it): that is latency, never corruption — the
+        caller's tick simply takes longer and every invariant must hold
+        across it.  `last_sync_s` exposes the most recent barrier's
+        wall time so a stalling disk is observable without a profiler."""
         if not self._pending:
             return
+        import time as _t
+        t0 = _t.monotonic()
         if self._lib is not None:
             if self._lib.wal_sync(self._h) != 0:
                 raise OSError("native WAL sync failed")
         else:
             fsio.fsync_file(self._f)
+        self.last_sync_s = _t.monotonic() - t0
         self._pending = False
         if self._bytes >= self.segment_bytes:
             self._rotate()

@@ -25,10 +25,24 @@ The ColSection carries the columnar payload-free fast path (base.py
 ColRecs): raw little-endian array dumps, decoded with zero per-record
 work.  Decoders treat trailing bytes after the snapshot section as a
 ColSection; its presence is optional for senders.
+
+CRC framing (the wire transports' form):
+
+    framed := u32 crc32(frame) | frame
+
+`encode_batch_framed`/`decode_batch_framed` wrap the flat encoding in a
+whole-frame CRC32, and `decode_batch` itself bounds-validates every
+declared count/length against the remaining bytes — so a corrupted,
+truncated, or Byzantine frame surfaces as `FrameCorruptError` (or
+`struct.error`) at the codec boundary, for the receiver to DROP and
+count, never as an out-of-bounds read, a silently-truncated payload, or
+a crashed recv thread.  The reference trusts rafthttp framing outright
+(reference raft.go:268-270); a multi-host deployment cannot.
 """
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import List, Tuple
 
 import numpy as np
@@ -42,6 +56,12 @@ _VOTE = struct.Struct("<IBqqqB")
 _APP = struct.Struct("<IBqqqqBqqH")
 _PLEN = struct.Struct("<I")
 _SNAP = struct.Struct("<Iqqq")
+
+
+class FrameCorruptError(ValueError):
+    """A wire frame failed its CRC or structural validation: drop the
+    frame (and count it) — raft re-sends, and a bad peer must not crash
+    the receiver."""
 
 
 def encode_batch(batch: TickBatch) -> bytes:
@@ -96,7 +116,14 @@ _COL_A = ("a_group", "a_type", "a_term", "a_prev_idx", "a_prev_term",
 
 
 def decode_batch(blob: bytes) -> TickBatch:
+    """Decode one flat frame, bounds-validating EVERY declared count and
+    length against the remaining bytes.  A frame that declares more
+    records/bytes than it carries (truncation, corruption, or a hostile
+    peer) raises struct.error — the original blob slicing silently
+    truncated payloads instead, handing short entry bytes to the raft
+    log."""
     off = 0
+    end = len(blob)
 
     def take(fmt: struct.Struct) -> Tuple:
         nonlocal off
@@ -104,22 +131,31 @@ def decode_batch(blob: bytes) -> TickBatch:
         off += fmt.size
         return vals
 
+    def need(nbytes: int, what: str) -> None:
+        if nbytes < 0 or end - off < nbytes:
+            raise struct.error(
+                f"frame truncated in {what}: {nbytes} bytes declared, "
+                f"{end - off} remain")
+
     batch = TickBatch()
     (nv,) = take(_U32)
+    need(nv * _VOTE.size, "vote section")
     for _ in range(nv):
         g, t, term, li, lt, gr = take(_VOTE)
         batch.votes.append(VoteRec(group=g, type=t, term=term, last_idx=li,
                                    last_term=lt, granted=bool(gr)))
     (na,) = take(_U32)
+    need(na * _APP.size, "append section")
     for _ in range(na):
         g, t, term, pi, pt, cm, su, ma, seq, n = take(_APP)
+        need(8 * n, "append entry terms")
         terms = list(struct.unpack_from(f"<{n}q", blob, off))
         off += 8 * n
         payloads: List[bytes] = []
         if t == MSG_REQ:
             for _ in range(n):
-                (plen,) = _PLEN.unpack_from(blob, off)
-                off += _PLEN.size
+                (plen,) = take(_PLEN)
+                need(plen, "append payload")
                 payloads.append(blob[off:off + plen])
                 off += plen
         batch.appends.append(AppendRec(
@@ -127,19 +163,21 @@ def decode_batch(blob: bytes) -> TickBatch:
             ent_terms=terms, payloads=payloads, commit=cm,
             success=bool(su), match=ma, seq=seq))
     (np_,) = take(_U32)
+    need(np_ * (_U32.size + _PLEN.size), "proposal section")
     for _ in range(np_):
         (g,) = take(_U32)
-        (plen,) = _PLEN.unpack_from(blob, off)
-        off += _PLEN.size
+        (plen,) = take(_PLEN)
+        need(plen, "proposal payload")
         batch.proposals.append(ProposalRec(group=g,
                                            payload=blob[off:off + plen]))
         off += plen
     if off < len(blob):
         (ns,) = take(_U32)
+        need(ns * (_SNAP.size + _PLEN.size), "snapshot section")
         for _ in range(ns):
             g, li, lt, term = take(_SNAP)
-            (blen,) = _PLEN.unpack_from(blob, off)
-            off += _PLEN.size
+            (blen,) = take(_PLEN)
+            need(blen, "snapshot blob")
             batch.snapshots.append(SnapshotRec(
                 group=g, last_idx=li, last_term=lt, term=term,
                 blob=blob[off:off + blen]))
@@ -172,3 +210,25 @@ def decode_batch(blob: bytes) -> TickBatch:
         if nv_ or na_:
             batch.cols = cols
     return batch
+
+
+def encode_batch_framed(batch: TickBatch) -> bytes:
+    """Flat encoding prefixed with a whole-frame CRC32 — the form the
+    wire transports ship (loopback included, so every test run crosses
+    the production framing)."""
+    payload = encode_batch(batch)
+    return _U32.pack(zlib.crc32(payload)) + payload
+
+
+def decode_batch_framed(blob: bytes) -> TickBatch:
+    """Verify the frame CRC, then decode.  Raises FrameCorruptError on
+    any mismatch — a flipped bit anywhere in the frame is caught here,
+    BEFORE record decoding can misinterpret corrupt lengths/ids."""
+    if len(blob) < _U32.size:
+        raise FrameCorruptError(f"frame too short ({len(blob)} bytes)")
+    (crc,) = _U32.unpack_from(blob)
+    payload = blob[_U32.size:]
+    if zlib.crc32(payload) != crc:
+        raise FrameCorruptError(
+            f"frame CRC mismatch ({len(blob)} bytes)")
+    return decode_batch(payload)

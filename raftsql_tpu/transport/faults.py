@@ -79,6 +79,27 @@ def release_messages(inbox: Inbox, held: Inbox) -> Inbox:
                    for n in Inbox._fields])
 
 
+def asym_partition(inbox: Inbox, src: int | jax.Array,
+                   dst: int | jax.Array) -> Inbox:
+    """One-directional partition of a stacked cluster inbox: `dst` stops
+    hearing `src`, while `src` still hears `dst`.
+
+    This is the half-open failure mode a full isolation cannot express
+    (a one-way firewall rule, a dead NIC receive queue): the deaf side
+    keeps timing out and probing while the other side believes the link
+    is healthy — exactly the schedule where prevote's lease check and
+    the term-bump rules earn their keep ("Paxos vs Raft" §4's
+    asymmetric-partition liveness scenarios).  inbox leaves are
+    [P_dst, G, P_src, ...]; we zero only row dst == `dst`, column
+    src == `src`.
+    """
+    P = inbox.v_type.shape[0]
+    dmask = (jnp.arange(P) == dst)[:, None, None]     # [P, 1, 1]
+    smask = (jnp.arange(P) == src)[None, None, :]     # [1, 1, P]
+    drop = jnp.broadcast_to(dmask & smask, inbox.v_type.shape)
+    return drop_messages(inbox, drop)
+
+
 def partition_peer(inbox: Inbox, peer: int | jax.Array) -> Inbox:
     """Isolate one peer of a stacked cluster inbox: nothing in, nothing out.
 

@@ -11,11 +11,14 @@ counterpart of transport.faults for the device plane.
 """
 from __future__ import annotations
 
+import struct
 import threading
 from typing import Callable, Dict, Optional, Set, Tuple
 
 from raftsql_tpu.transport.base import TickBatch, Transport
-from raftsql_tpu.transport.codec import decode_batch, encode_batch
+from raftsql_tpu.transport.codec import (FrameCorruptError,
+                                         decode_batch_framed,
+                                         encode_batch_framed)
 
 
 class FaultPlan:
@@ -30,6 +33,13 @@ class FaultPlan:
             for other in universe:
                 self._blocked.add((node, other))
                 self._blocked.add((other, node))
+
+    def block(self, src: int, dst: int) -> None:
+        """Block ONE direction: dst stops hearing src while src still
+        hears dst — the asymmetric-partition failure mode (a dead NIC
+        queue, a one-way firewall rule) the chaos matrix schedules."""
+        with self._lock:
+            self._blocked.add((src, dst))
 
     def heal(self) -> None:
         with self._lock:
@@ -54,6 +64,17 @@ class LoopbackHub:
         self._lock = threading.Lock()
         self.faults = faults or FaultPlan()
         self.codec = codec
+        # Wire-corruption seam (chaos harness): a callable
+        # (src, dst, blob) -> blob mutating the encoded frame in
+        # flight.  The CRC framing then catches the damage at decode
+        # and the frame is dropped + counted, exactly as on the TCP
+        # path.  None in normal runs.
+        self.mangler: Optional[Callable[[int, int, bytes], bytes]] = None
+        # Corrupt frames dropped by the CRC check, and an optional
+        # per-drop callback (the chaos runner uses it to charge the
+        # receiving node's NodeMetrics.faults_corrupt_frames).
+        self.corrupt_dropped = 0
+        self.on_corrupt: Optional[Callable[[int, int], None]] = None
 
     def attach(self, node_id: int,
                deliver: Callable[[int, TickBatch], None]) -> None:
@@ -70,8 +91,20 @@ class LoopbackHub:
             return
         with self._lock:
             deliver = self._nodes.get(dst)
-        if deliver is not None:            # absent peer == dropped message
-            deliver(src, decode_batch(batch) if self.codec else batch)
+        if deliver is None:                # absent peer == dropped message
+            return
+        if self.codec:
+            if self.mangler is not None:
+                batch = self.mangler(src, dst, batch)
+            try:
+                batch = decode_batch_framed(batch)
+            except (FrameCorruptError, ValueError, struct.error):
+                with self._lock:
+                    self.corrupt_dropped += 1
+                if self.on_corrupt is not None:
+                    self.on_corrupt(src, dst)
+                return
+        deliver(src, batch)
 
 
 class LoopbackTransport(Transport):
@@ -89,7 +122,8 @@ class LoopbackTransport(Transport):
         if batch.empty():
             return
         self.hub.route(self.node_id, dst,
-                       encode_batch(batch) if self.hub.codec else batch)
+                       encode_batch_framed(batch) if self.hub.codec
+                       else batch)
 
     def stop(self) -> None:
         self.hub.detach(self.node_id)

@@ -15,6 +15,20 @@ Each peer gets a dedicated sender thread with a bounded queue so a slow or
 dead peer can never stall the tick loop.  Accepted connections get TCP
 keepalive, standing in for the reference's 3-minute keepalive period
 (listener.go:55-57).
+
+Robustness (PR 2 fault matrix):
+  * payloads are CRC32-framed (codec.encode_batch_framed) — a frame
+    corrupted anywhere between hosts is DROPPED and counted
+    (NodeMetrics.faults_corrupt_frames via the `metrics` attribute the
+    node wires in), and the recv loop keeps serving later frames;
+  * any decode exception is confined to the frame: it can no longer
+    kill the connection thread silently — the frame is skipped, the
+    length-prefixed stream stays in sync, the listener stays alive;
+  * `SendFaults` is the injectable send-side fault seam mirroring
+    transport/faults.py's device-plane masks: seeded drop / corrupt /
+    delay / one-directional block applied to encoded frames, so the
+    chaos harness (chaos/scenarios.py) exercises THIS transport, not a
+    stand-in.
 """
 from __future__ import annotations
 
@@ -24,10 +38,14 @@ import socket
 import struct
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
 
 from raftsql_tpu.transport.base import TickBatch, Transport
-from raftsql_tpu.transport.codec import decode_batch, encode_batch
+from raftsql_tpu.transport.codec import (FrameCorruptError,
+                                         decode_batch_framed,
+                                         encode_batch_framed)
 
 log = logging.getLogger("raftsql_tpu.tcp")
 
@@ -40,6 +58,75 @@ _QUEUE_CAP = 1024
 # so the connection is dropped instead — the node itself must survive bad
 # peers (see runtime/node.py _deliver).
 _MAX_FRAME = 64 << 20
+
+
+class SendFaults:
+    """Seeded send-side fault injection for TcpTransport.
+
+    The device plane's chaos masks (transport/faults.py) cannot reach
+    this transport — frames leave through real sockets.  This seam
+    applies the same fault classes to each ENCODED frame at send time:
+
+      * one-directional blocks (`block`/`unblock`): frames to a blocked
+        dst are dropped while the reverse direction flows — the
+        asymmetric-partition failure mode;
+      * seeded random drop (p_drop), corruption (p_corrupt — one byte
+        of the framed payload is flipped, so the receiver's CRC check
+        must catch and drop it), and delay (p_delay, delay_s — the
+        frame is re-offered later from a timer thread, modeling
+        out-of-order arrival).
+
+    Thread-safe; all decisions come from one seeded rng so a given
+    (seed, send sequence) reproduces the same fault pattern.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._blocked: Set[int] = set()
+        self.p_drop = 0.0
+        self.p_corrupt = 0.0
+        self.p_delay = 0.0
+        self.delay_s = 0.0
+        self.dropped = 0
+        self.corrupted = 0
+        self.delayed = 0
+
+    def block(self, dst: int) -> None:
+        """Stop delivering to node `dst` (1-based) — one direction only."""
+        with self._lock:
+            self._blocked.add(dst)
+
+    def heal(self) -> None:
+        with self._lock:
+            self._blocked.clear()
+
+    def set_rates(self, p_drop: float = 0.0, p_corrupt: float = 0.0,
+                  p_delay: float = 0.0, delay_s: float = 0.0) -> None:
+        with self._lock:
+            self.p_drop = p_drop
+            self.p_corrupt = p_corrupt
+            self.p_delay = p_delay
+            self.delay_s = delay_s
+
+    def apply(self, dst: int, blob: bytes
+              ) -> Optional[Tuple[bytes, float]]:
+        """(possibly-mangled blob, delay_s) — or None to drop."""
+        with self._lock:
+            if dst in self._blocked:
+                self.dropped += 1
+                return None
+            if self.p_drop and self._rng.random() < self.p_drop:
+                self.dropped += 1
+                return None
+            if self.p_corrupt and self._rng.random() < self.p_corrupt:
+                i = int(self._rng.integers(0, len(blob)))
+                blob = blob[:i] + bytes([blob[i] ^ 0x5A]) + blob[i + 1:]
+                self.corrupted += 1
+            if self.p_delay and self._rng.random() < self.p_delay:
+                self.delayed += 1
+                return blob, self.delay_s
+        return blob, 0.0
 
 
 def parse_peer_url(url: str) -> Tuple[str, int]:
@@ -112,6 +199,14 @@ class TcpTransport(Transport):
         node i serves at peers[i-1])."""
         self.addrs = [parse_peer_url(u) for u in peer_urls]
         self.self_index = self_index          # 0-based
+        # Wired by the owning node (runtime/node.py start) so transport
+        # fault counters land in the node's /metrics; a bare transport
+        # (tests) counts into its own scratch NodeMetrics.
+        from raftsql_tpu.utils.metrics import NodeMetrics
+        self.metrics = NodeMetrics()
+        # Injectable send-side fault seam (chaos harness); None in
+        # production.
+        self.faults: Optional[SendFaults] = None
         self._stop_evt = threading.Event()
         self._senders: Dict[int, _PeerSender] = {}
         self._listener: Optional[socket.socket] = None
@@ -176,7 +271,21 @@ class TcpTransport(Transport):
                         break
                     payload = buf[_FRAME.size:_FRAME.size + plen]
                     buf = buf[_FRAME.size + plen:]
-                    self._deliver(src, decode_batch(payload))
+                    # A corrupt or malformed frame must cost exactly that
+                    # frame: the length prefix already resynced the
+                    # stream, so drop it, count it, keep receiving.
+                    # Before this guard a decode exception killed the
+                    # connection thread silently and every later frame
+                    # with it.
+                    try:
+                        batch = decode_batch_framed(payload)
+                    except (FrameCorruptError, struct.error,
+                            ValueError) as e:
+                        self.metrics.faults_corrupt_frames += 1
+                        log.warning("dropping corrupt frame from src %d "
+                                    "(%d bytes): %s", src, plen, e)
+                        continue
+                    self._deliver(src, batch)
                 try:
                     chunk = conn.recv(1 << 16)
                 except socket.timeout:
@@ -193,8 +302,20 @@ class TcpTransport(Transport):
         if batch.empty():
             return
         sender = self._senders.get(dst)
-        if sender is not None:
-            sender.offer(encode_batch(batch))
+        if sender is None:
+            return
+        blob = encode_batch_framed(batch)
+        if self.faults is not None:
+            got = self.faults.apply(dst, blob)
+            if got is None:
+                return                       # injected drop / block
+            blob, delay = got
+            if delay > 0:
+                t = threading.Timer(delay, sender.offer, args=(blob,))
+                t.daemon = True
+                t.start()
+                return
+        sender.offer(blob)
 
     def stop(self) -> None:
         self._stop_evt.set()

@@ -32,6 +32,17 @@ class NodeMetrics:
     faults_partitions: int = 0
     faults_crashes: int = 0
     faults_fsync: int = 0
+    # Extended fault matrix (PR 2): corrupt wire frames dropped by the
+    # CRC-framed codec (transport/codec.py + tcp/_recv_loop), ENOSPC
+    # write failures surfaced by the WAL (storage/fsio.py), fsync
+    # latency stalls survived, and per-peer clock-skew timer deviation
+    # applied (runtime/fused.py timer_inc seam).  corrupt_frames is ALSO
+    # live in production: any bad frame a TCP peer sends is counted
+    # here, not just injected ones.
+    faults_corrupt_frames: int = 0
+    faults_enospc: int = 0
+    faults_fsync_stalls: int = 0
+    faults_skew_ticks: int = 0
     # Per-phase tick wall time, accumulated by RaftNode.tick (SURVEY.md
     # §5.1 live profiling): staging (installs + inbox build) / device
     # step / WAL fsync / send / publish.
@@ -61,6 +72,10 @@ class NodeMetrics:
                 "partitions": self.faults_partitions,
                 "crashes": self.faults_crashes,
                 "fsync": self.faults_fsync,
+                "corrupt_frames": self.faults_corrupt_frames,
+                "enospc": self.faults_enospc,
+                "fsync_stalls": self.faults_fsync_stalls,
+                "skew_ticks": self.faults_skew_ticks,
             },
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),

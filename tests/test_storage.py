@@ -403,6 +403,81 @@ class TestPayloadLog:
         assert pl.try_term_of(0, 2) == 1          # boundary term kept
         assert pl.try_term_of(0, 1) is None       # below the floor
 
+    def test_try_slice_floor_race_paths(self):
+        """try_slice degrades to None when the requested range dips
+        below a (concurrently advancing) compaction floor — the atomic
+        check-then-slice the send path relies on."""
+        from raftsql_tpu.storage.log import PayloadLog
+        pl = PayloadLog(1)
+        pl.put(0, 1, [b"a", b"b", b"c", b"d", b"e"], [1] * 5)
+        assert pl.try_slice(0, 2, 3) == [b"b", b"c", b"d"]
+        pl.compact(0, 3, 1)
+        assert pl.try_slice(0, 2, 3) is None      # starts below floor
+        assert pl.try_slice(0, 4, 2) == [b"d", b"e"]
+        # A short tail read returns what exists (caller length-checks),
+        # never wraps to the list head.
+        assert pl.try_slice(0, 5, 4) == [b"e"]
+
+    def test_try_tail_with_terms_boundary(self):
+        """Atomic (prev_term, entries) read for catch-up appends: the
+        floor's retained boundary term serves prev_term exactly at the
+        edge, and a compacted-away start returns None (InstallSnapshot
+        territory)."""
+        from raftsql_tpu.storage.log import PayloadLog
+        pl = PayloadLog(1)
+        pl.put(0, 1, [b"a", b"b", b"c", b"d"], [1, 2, 2, 3])
+        prev, ents = pl.try_tail_with_terms(0, 1, 2)
+        assert prev == 0 and ents == [(1, b"a"), (2, b"b")]
+        pl.compact(0, 2, 2)
+        assert pl.try_tail_with_terms(0, 2, 2) is None   # at the floor
+        prev, ents = pl.try_tail_with_terms(0, 3, 4)
+        assert prev == 2                  # boundary term, not a wrap
+        assert ents == [(2, b"c"), (3, b"d")]
+
+    def test_try_accessors_race_live_compactor(self):
+        """Hammer try_term_of/try_slice/try_tail_with_terms from a
+        reader thread while the owner thread compacts: every result is
+        either None or internally consistent (terms match what was
+        written at those absolute positions) — no asserts, no wrapped
+        negative indexes, no torn (start, lists) reads."""
+        import threading
+        from raftsql_tpu.storage.log import PayloadLog
+        pl = PayloadLog(1)
+        N = 400
+        pl.put(0, 1, [b"%d" % i for i in range(1, N + 1)],
+               list(range(1, N + 1)))        # term i at index i
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    for idx in (1, N // 3, N // 2, N):
+                        t = pl.try_term_of(0, idx)
+                        assert t is None or t == idx, (idx, t)
+                        got = pl.try_slice(0, idx, 3)
+                        assert got is None \
+                            or got == [b"%d" % i for i in
+                                       range(idx, min(idx + 3, N + 1))]
+                        tail = pl.try_tail_with_terms(0, idx, 2)
+                        if tail is not None:
+                            prev, ents = tail
+                            assert prev == idx - 1
+                            assert all(t == i for (t, _), i in
+                                       zip(ents, range(idx, idx + 2)))
+            except Exception as e:          # pragma: no cover - failure
+                errors.append(e)
+
+        th = threading.Thread(target=reader)
+        th.start()
+        try:
+            for floor in range(2, N, 7):
+                pl.compact(0, floor, floor)
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        assert not errors, errors[0]
+
 
 class TestNativeWAL:
     """The C++ write path (native/wal.cc) must be byte-identical to the

@@ -15,7 +15,7 @@ import time
 from conftest import free_port
 from raftsql_tpu.transport.base import (AppendRec, ProposalRec, SnapshotRec,
                                         TickBatch, VoteRec)
-from raftsql_tpu.transport.codec import encode_batch
+from raftsql_tpu.transport.codec import encode_batch_framed
 from raftsql_tpu.transport.tcp import (_FRAME, _QUEUE_CAP, _PeerSender,
                                        TcpTransport, parse_peer_url)
 
@@ -72,7 +72,7 @@ class TestWire:
         """Frames split at every possible recv boundary must reassemble."""
         rx = Receiver()
         try:
-            blob = encode_batch(sample_batch())
+            blob = encode_batch_framed(sample_batch())
             wire = _FRAME.pack(len(blob), 1) + blob
             with socket.create_connection(("127.0.0.1", rx.port),
                                           timeout=5) as s:
@@ -94,7 +94,7 @@ class TestWire:
             for k in range(5):
                 b = TickBatch(proposals=[ProposalRec(group=0,
                                                      payload=b"p%d" % k)])
-                blob = encode_batch(b)
+                blob = encode_batch_framed(b)
                 frames += _FRAME.pack(len(blob), 1) + blob
             with socket.create_connection(("127.0.0.1", rx.port),
                                           timeout=5) as s:
@@ -130,7 +130,7 @@ class TestWire:
         delivers, then the connection drops."""
         rx = Receiver()
         try:
-            blob = encode_batch(sample_batch())
+            blob = encode_batch_framed(sample_batch())
             wire = _FRAME.pack(len(blob), 1) + blob \
                 + _FRAME.pack(0xFFFFFFFF, 1)
             with socket.create_connection(("127.0.0.1", rx.port),
@@ -141,6 +141,104 @@ class TestWire:
             assert rx.got.empty()
         finally:
             rx.stop()
+
+    def test_corrupt_frame_skipped_connection_survives(self):
+        """A CRC-corrupt frame is dropped + counted, and the SAME
+        connection keeps delivering later frames — the recv loop must
+        not die with the frame (the pre-hardening behavior killed the
+        thread silently)."""
+        rx = Receiver()
+        try:
+            good = encode_batch_framed(sample_batch())
+            bad = bytearray(good)
+            bad[len(bad) // 2] ^= 0x5A
+            wire = (_FRAME.pack(len(bad), 1) + bytes(bad)
+                    + _FRAME.pack(len(good), 1) + good)
+            with socket.create_connection(("127.0.0.1", rx.port),
+                                          timeout=5) as s:
+                s.sendall(wire)
+                src, got = rx.got.get(timeout=TIMEOUT)
+            assert src == 1
+            assert_batches_equal(got, sample_batch())
+            assert rx.transport.metrics.faults_corrupt_frames == 1
+            assert rx.errors == []      # never fatal locally
+        finally:
+            rx.stop()
+
+    def test_malformed_counts_dropped_not_fatal(self):
+        """A frame whose CRC is valid but whose declared record counts
+        exceed its bytes (a Byzantine sender) is dropped by the codec's
+        bounds validation, and later frames still deliver."""
+        import struct as _struct
+        import zlib as _zlib
+        rx = Receiver()
+        try:
+            # Declares 1000 votes, carries none.
+            payload = _struct.pack("<I", 1000)
+            evil = _struct.pack("<I", _zlib.crc32(payload)) + payload
+            good = encode_batch_framed(sample_batch())
+            wire = (_FRAME.pack(len(evil), 1) + evil
+                    + _FRAME.pack(len(good), 1) + good)
+            with socket.create_connection(("127.0.0.1", rx.port),
+                                          timeout=5) as s:
+                s.sendall(wire)
+                src, got = rx.got.get(timeout=TIMEOUT)
+            assert_batches_equal(got, sample_batch())
+            assert rx.transport.metrics.faults_corrupt_frames == 1
+        finally:
+            rx.stop()
+
+
+class TestSendFaults:
+    def test_send_faults_corrupt_caught_by_receiver(self):
+        """End-to-end over real sockets: the send-side fault seam
+        corrupts frames, the receiver's CRC drops + counts every one,
+        and clean frames still flow once rates reset."""
+        from raftsql_tpu.transport.tcp import SendFaults
+        rx_port = free_port()
+        urls = [f"http://127.0.0.1:{free_port()}",
+                f"http://127.0.0.1:{rx_port}"]
+        got: "queue.Queue" = queue.Queue()
+        rx = TcpTransport(urls, 1)
+        rx.start(2, lambda s, b: got.put((s, b)), lambda e: None)
+        tx = TcpTransport(urls, 0)
+        tx.faults = SendFaults(seed=7)
+        tx.faults.set_rates(p_corrupt=1.0)
+        tx.start(1, lambda s, b: None, lambda e: None)
+        try:
+            deadline = time.monotonic() + TIMEOUT
+            while rx.metrics.faults_corrupt_frames == 0 \
+                    and time.monotonic() < deadline:
+                tx.send(2, sample_batch())
+                time.sleep(0.05)
+            assert rx.metrics.faults_corrupt_frames > 0
+            assert tx.faults.corrupted > 0
+            assert got.empty()          # nothing corrupt delivered
+            tx.faults.set_rates()       # heal: clean frames deliver
+            deadline = time.monotonic() + TIMEOUT
+            while got.empty() and time.monotonic() < deadline:
+                tx.send(2, sample_batch())
+                time.sleep(0.05)
+            src, batch = got.get(timeout=1)
+            assert_batches_equal(batch, sample_batch())
+        finally:
+            tx.stop()
+            rx.stop()
+
+    def test_send_faults_block_is_one_directional(self):
+        """block(dst) drops at send; drop/delay counters track."""
+        from raftsql_tpu.transport.tcp import SendFaults
+        f = SendFaults(seed=0)
+        f.block(2)
+        assert f.apply(2, b"x") is None
+        assert f.apply(3, b"x") == (b"x", 0.0)
+        assert f.dropped == 1
+        f.heal()
+        assert f.apply(2, b"x") == (b"x", 0.0)
+        f.set_rates(p_delay=1.0, delay_s=0.25)
+        blob, delay = f.apply(2, b"y")
+        assert blob == b"y" and delay == 0.25
+        assert f.delayed == 1
 
 
 class TestSenderBackpressure:
