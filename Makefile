@@ -4,7 +4,7 @@
 PY ?= python
 SEED ?= 0
 
-.PHONY: all native test vet bench chaos clean
+.PHONY: all native test vet bench chaos trace clean
 
 # "Build" = compile the native C++ components (storage fast path).
 all: native
@@ -46,6 +46,13 @@ chaos:
 chaos-matrix:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --matrix --seed $(SEED)
+
+# Observability demo (raftsql_tpu/obs/): run a traced fused cluster and
+# emit Chrome trace-event JSON — load trace.json at ui.perfetto.dev or
+# chrome://tracing.  The same spans/counters are live on a running
+# server at GET /trace and GET /events (enable with --trace).
+trace:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.obs.trace_demo --out trace.json
 
 # ThreadSanitizer pass over the native WAL's locking (SURVEY.md §5.2):
 # 4 threads x appends/hardstate/compact/snapshot/sync on one handle.

@@ -139,6 +139,16 @@ class _Conn(asyncio.Protocol):
                     self.tr.write(_resp(200, b"OK", payload,
                                         b"application/json"))
                     continue
+                if path in (b"/trace", b"/events"):
+                    # Observability exports (raftsql_tpu/obs/): Chrome
+                    # trace JSON / raw event rows, parity with the
+                    # threaded plane.
+                    render = (self.srv.rdb.render_trace
+                              if path == b"/trace"
+                              else self.srv.rdb.render_events)
+                    self.tr.write(_resp(200, b"OK", render().encode(),
+                                        b"application/json"))
+                    continue
                 self.busy = True
                 self.srv.loop.create_task(self._do_get(headers, body))
             elif method == b"HEAD":

@@ -89,6 +89,19 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(200, rdb.render_metrics().encode(),
                            ctype="application/json")
                 return
+            if self.path == "/trace":
+                # Chrome trace-event JSON (Perfetto-loadable): the span
+                # tracer + device event ring (raftsql_tpu/obs/).  Valid
+                # empty document while tracing is off (the default).
+                self._body()    # drain — keep-alive
+                self._send(200, rdb.render_trace().encode(),
+                           ctype="application/json")
+                return
+            if self.path == "/events":
+                self._body()    # drain — keep-alive
+                self._send(200, rdb.render_events().encode(),
+                           ctype="application/json")
+                return
             try:
                 linear = (self.headers.get("X-Consistency", "")
                           .lower() == "linear")

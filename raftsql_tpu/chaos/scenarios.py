@@ -205,6 +205,11 @@ class FusedChaosRunner:
         if self.steps > 1:
             node._steps = self.steps
         node.publish_peers = {0}
+        # Flight recorder feed (raftsql_tpu/obs/): device event ring +
+        # host spans, dumped next to the seed on invariant failure.
+        # Tracing never touches consensus state, so the run's schedule
+        # and result digests are unchanged.
+        node.enable_tracing()
         replayed: Dict[Tuple[int, int], bytes] = {}
         order: List[Tuple[int, int, bytes]] = []
         for p in range(self.cfg.num_peers):
@@ -459,11 +464,29 @@ class FusedChaosRunner:
                 # Survives node teardown so tests can assert the
                 # exported counters (the /metrics surface).
                 self.final_metrics = m
+            except InvariantViolation as e:
+                # Flight recorder: every invariant failure becomes a
+                # post-mortem artifact — the last N ticks of device
+                # events plus the host spans, next to the failing seed.
+                self._flight_dump(e)
+                raise
             finally:
                 node, self.node = self.node, None
                 if node is not None:
                     node.stop()
         return self._report()
+
+    def _flight_dump(self, err: Exception) -> None:
+        from raftsql_tpu.obs.flight import FlightRecorder
+        node = self.node
+        if node is None:
+            return
+        FlightRecorder().dump(
+            f"fused-seed{self.sched.seed}", repr(err),
+            tracer=node.tracer, ring=node.ring,
+            meta={"seed": self.sched.seed,
+                  "schedule_digest": self.sched.digest(),
+                  "report": dict(self.report)})
 
     def _report(self) -> dict:
         committed = sorted(
@@ -590,6 +613,7 @@ class NodeClusterChaosRunner:
     def _boot(self, p: int) -> RaftNode:
         n = RaftNode(p + 1, self.P, self.cfg,
                      LoopbackTransport(self.hub), self._data_dir(p))
+        n.enable_tracing()          # flight-recorder feed (host spans)
         n.start(threaded=False)
         # Replay drain: every WAL entry then the nil sentinel
         # (raft.go:122-134).  Verify durability of everything this node
@@ -740,12 +764,27 @@ class NodeClusterChaosRunner:
                     self._observe(t)
                     self._post_tick(t, healing)
                 self._final_check()
+            except InvariantViolation as e:
+                self._flight_dump(e)
+                raise
             finally:
                 for n in self.nodes:
                     if n is not None:
                         n.stop()
         return {"plan_digest": self.plan.digest(),
                 "result_digest": self._result_digest(), **self.report}
+
+    def _flight_dump(self, err: Exception) -> None:
+        """Host-plane flight dump (this plane has no device ring): the
+        first live node's spans, next to the failing seed."""
+        from raftsql_tpu.obs.flight import FlightRecorder
+        tracer = next((n.tracer for n in self.nodes if n is not None),
+                      None)
+        FlightRecorder().dump(
+            f"node-seed{self.plan.seed}", repr(err), tracer=tracer,
+            meta={"seed": self.plan.seed,
+                  "plan_digest": self.plan.digest(),
+                  "report": dict(self.report)})
 
     def _result_digest(self) -> str:
         """Digest of the run's committed (unwrapped) history + fault

@@ -1,0 +1,34 @@
+"""Observability subsystem: device-plane event rings, host-plane span
+tracing, Chrome-trace (Perfetto) export, and the chaos flight recorder.
+
+Two planes, matching the engine's own split:
+
+  * DEVICE plane (`device_ring.py`): a tick-indexed on-device event
+    ring — a fixed-shape [depth, P, G, NEV] i32 array the fused runtime
+    writes one slot per tick (one tiny fused dispatch; no host
+    round-trip), drained to the host in whole-ring batches so the
+    steady-state cost is ~one device_get per `depth` ticks.
+  * HOST plane (`spans.py`): a span tracer following each proposal
+    through its lifecycle (propose → WAL append → replicate → quorum →
+    commit → apply → ack) with monotonic timestamps, plus a generic
+    timeline-event ring for WAL fsyncs, TCP frames, and anything else
+    the host planes want on the trace.
+
+Exports (`export.py`): Chrome trace-event JSON loadable in Perfetto
+(`make trace`, `GET /trace`), raw event JSON (`GET /events`).  The
+chaos harness wires both planes into a flight recorder (`flight.py`):
+an invariant failure dumps the last N ticks of device events plus the
+host spans next to the failing seed.
+
+Everything here is OFF by default: the engine carries a `tracer`/`ring`
+attribute that is None until `enable_tracing()` is called, and every
+hook is gated on that attribute — the disabled cost is one attribute
+test, and the fused scan signatures are untouched.
+"""
+from raftsql_tpu.obs.device_ring import EVENT_FIELDS, DeviceEventRing
+from raftsql_tpu.obs.export import chrome_trace, validate_chrome_trace
+from raftsql_tpu.obs.flight import FlightRecorder
+from raftsql_tpu.obs.spans import SpanTracer
+
+__all__ = ["EVENT_FIELDS", "DeviceEventRing", "SpanTracer",
+           "chrome_trace", "validate_chrome_trace", "FlightRecorder"]

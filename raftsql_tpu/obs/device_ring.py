@@ -1,0 +1,135 @@
+"""Tick-indexed on-device event ring for the fused cluster runtimes.
+
+The fused runtime's consensus math is opaque to the host: one dispatch
+advances P peers x G groups and the host sees only the packed StepInfo
+it needs for durability.  `NodeMetrics` aggregates further, to run
+totals.  This ring is the per-tick history between those extremes: a
+fixed-shape [depth, P, G, NEV] i32 array living ON DEVICE, written one
+slot per tick by a single small fused program (`_record_slot`), and
+drained to the host in whole-ring batches — so with tracing enabled the
+per-tick cost is one extra dispatch over already-resident arrays, and
+one device_get every `depth` ticks; with tracing disabled (the
+default) nothing here runs and the step signatures
+(core/cluster.py cluster_step_host / cluster_multistep_host) are
+untouched.
+
+Per (peer, group) the slot records (EVENT_FIELDS order): the tick
+number, term, role, leader hint, commit index, host applied index (the
+host's pre-publish cursor, passed in), device log length, inbox depth
+(message slots in flight to the NEXT tick — the post-step delivered
+inbox), and the vote tally.  Everything the chaos post-mortems and the
+Perfetto counter tracks need to say WHY a tick behaved as it did.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.core.step import INFO_FIELDS
+
+EVENT_FIELDS = ("tick", "term", "role", "leader", "commit", "applied",
+                "log_len", "inbox_depth", "votes")
+NEV = len(EVENT_FIELDS)
+
+_C = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _record_slot(ring: jax.Array, slot: jax.Array, tick_no: jax.Array,
+                 pinfo: jax.Array, votes: jax.Array, v_type: jax.Array,
+                 a_type: jax.Array, applied: jax.Array) -> jax.Array:
+    """Write one [P, G, NEV] event row into ring[slot].
+
+    pinfo is the step's packed [P, G, INFO_NCOLS] info (the final step
+    of a multi-step dispatch); votes/v_type/a_type are the post-step
+    stacked state/inbox leaves; applied is the host's [P, G] apply
+    cursor.  All reads are masks/stacks over resident arrays and the
+    write is one dynamic_update_slice — no gathers, no scatters
+    (ops/dense.py's TPU rule), so the traced tick stays cheap.
+    """
+    depth = ((v_type != 0).sum(-1) + (a_type != 0).sum(-1))     # [P, G]
+    nvotes = votes.sum(-1)                                      # [P, G]
+    tick_col = jnp.broadcast_to(jnp.asarray(tick_no, jnp.int32),
+                                depth.shape)
+    ev = jnp.stack([tick_col,
+                    pinfo[:, :, _C["term"]],
+                    pinfo[:, :, _C["role"]],
+                    pinfo[:, :, _C["leader_hint"]],
+                    pinfo[:, :, _C["commit"]],
+                    applied,
+                    pinfo[:, :, _C["new_log_len"]],
+                    depth, nvotes], axis=-1).astype(jnp.int32)
+    return jax.lax.dynamic_update_slice_in_dim(ring, ev[None], slot,
+                                               axis=0)
+
+
+class DeviceEventRing:
+    """Host manager for the on-device ring: owns the device array, the
+    write cursor, and the drained host-side history (a bounded deque of
+    [P, G, NEV] numpy rows, newest last)."""
+
+    def __init__(self, num_peers: int, num_groups: int,
+                 depth: int = 64, keep: int = 4096):
+        self.depth = depth
+        self._ring = jnp.zeros((depth, num_peers, num_groups, NEV),
+                               jnp.int32)
+        self._slot = 0
+        self._events: deque = deque(maxlen=keep)
+        self.drains = 0
+        # record() runs on the tick thread; drain()/rows() also run on
+        # scrape threads (GET /trace, GET /events, the flight
+        # recorder).  The lock serializes ring/cursor/deque access —
+        # contention is one scrape against one tick, never tick-tick.
+        self._mu = threading.Lock()
+
+    def record(self, tick_no: int, pinfo_dev, votes, v_type, a_type,
+               applied: np.ndarray) -> None:
+        """Record one tick's events; auto-drains when the ring fills."""
+        with self._mu:
+            self._ring = _record_slot(
+                self._ring, jnp.asarray(self._slot, jnp.int32),
+                jnp.asarray(tick_no, jnp.int32), pinfo_dev, votes,
+                v_type, a_type, jnp.asarray(applied.astype(np.int32)))
+            self._slot += 1
+            if self._slot >= self.depth:
+                self._drain_locked()
+
+    def drain(self) -> None:
+        """Pull every undrained slot to the host (ONE device_get)."""
+        with self._mu:
+            self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        if self._slot == 0:
+            return
+        host = np.asarray(jax.device_get(self._ring))[:self._slot]
+        self._events.extend(host)
+        self._slot = 0
+        self.drains += 1
+
+    def rows(self, last: Optional[int] = None) -> List[dict]:
+        """Drained history as JSON-ready per-tick dicts (newest-last):
+        {"tick": t, "<field>": [[G values] per peer], ...}.  Call
+        drain() first for up-to-the-tick data."""
+        with self._mu:
+            events = list(self._events)
+        if last is not None:
+            events = events[-last:]
+        out = []
+        for row in events:                       # row: [P, G, NEV]
+            d = {"tick": int(row[0, 0, 0])}
+            for i, name in enumerate(EVENT_FIELDS):
+                if name != "tick":
+                    d[name] = row[:, :, i].tolist()
+            out.append(d)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._events)

@@ -1,0 +1,129 @@
+"""Chrome trace-event export — the JSON object format Perfetto and
+chrome://tracing load directly.
+
+One document, three track families:
+
+  * pid 1 "spans": per-group tracks of complete ("X") slices, one per
+    adjacent recorded phase pair of every span
+    (propose→append→replicate→commit→apply→ack), on the host monotonic
+    axis (us since the tracer epoch);
+  * pid 2 "host io": the tracer's timeline-event ring (WAL fsyncs, TCP
+    frames, ...) as duration slices or instants;
+  * pid 3 "device": counter ("C") tracks built from the device event
+    ring — commit / inbox depth / vote tally per (peer, group) — on a
+    SYNTHETIC tick axis (1 tick = `tick_us` microseconds), since device
+    ticks carry no wall clock.  Separate pid, so the axes never mix.
+
+`validate_chrome_trace` is the schema check the tests (and `make
+trace`) run over every emitted document, so "Perfetto accepts it" is an
+asserted property, not a hope.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from raftsql_tpu.obs.spans import PHASES
+
+_ALLOWED_PH = {"X", "B", "E", "i", "I", "C", "M"}
+
+
+def _meta(pid: int, name: str, tid: Optional[int] = None,
+          tname: Optional[str] = None) -> List[dict]:
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}]
+    if tid is not None:
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": tname or str(tid)}})
+    return out
+
+
+def chrome_trace(span_snapshot: Optional[dict] = None,
+                 device_rows: Optional[List[dict]] = None,
+                 tick_us: float = 1000.0, max_groups: int = 8) -> dict:
+    """Build the trace document from `SpanTracer.snapshot()` and/or
+    `DeviceEventRing.rows()`.  Either may be None/empty — the document
+    is always valid (an empty trace loads fine)."""
+    events: List[dict] = []
+    events += _meta(1, "raftsql spans")
+    seen_groups = set()
+
+    for sp in (span_snapshot or {}).get("spans", ()):
+        g = sp["group"]
+        if g not in seen_groups and len(seen_groups) < max_groups:
+            seen_groups.add(g)
+            events += _meta(1, "raftsql spans", tid=g,
+                            tname=f"group {g}")[1:]
+        ph = sp["phases"]
+        stamps = [(name, ph[name]) for name in PHASES if name in ph]
+        for (a, ta), (b, tb) in zip(stamps, stamps[1:]):
+            events.append({
+                "name": f"{a}→{b}", "cat": "span", "ph": "X",
+                "ts": ta, "dur": max(tb - ta, 0.0), "pid": 1, "tid": g,
+                "args": {"index": sp["index"], "key": sp["key"]}})
+
+    host_events = (span_snapshot or {}).get("events", ())
+    if host_events:
+        events += _meta(2, "raftsql host io", tid=0, tname="io")
+        for ev in host_events:
+            rec = {"name": ev["name"], "cat": "io", "ts": ev["ts"],
+                   "pid": 2, "tid": 0, "args": ev.get("args", {})}
+            if ev.get("dur", 0) > 0:
+                rec.update(ph="X", dur=ev["dur"])
+            else:
+                rec.update(ph="i", s="t")
+            events.append(rec)
+
+    if device_rows:
+        events += _meta(3, "raftsql device (tick axis)")
+        P = len(device_rows[0]["commit"])
+        G = min(len(device_rows[0]["commit"][0]), max_groups)
+        for row in device_rows:
+            ts = row["tick"] * tick_us
+            for p in range(P):
+                for g in range(G):
+                    for field in ("commit", "inbox_depth", "votes"):
+                        events.append({
+                            "name": f"p{p}/g{g} {field}", "ph": "C",
+                            "ts": ts, "pid": 3, "tid": 0,
+                            "args": {"value": row[field][p][g]}})
+
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Raise ValueError unless `doc` is a well-formed Chrome trace-event
+    JSON object: serializable, traceEvents a list, every event carrying
+    a name, a known phase, a pid, and (for non-metadata phases) a
+    non-negative numeric ts; complete events need a non-negative dur,
+    counters a numeric value."""
+    try:
+        json.dumps(doc)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"trace not JSON-serializable: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("trace must be an object with a traceEvents list")
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i}: not an object")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            raise ValueError(f"event {i}: missing name")
+        ph = ev.get("ph")
+        if ph not in _ALLOWED_PH:
+            raise ValueError(f"event {i}: bad phase {ph!r}")
+        if "pid" not in ev:
+            raise ValueError(f"event {i}: missing pid")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                raise ValueError(f"event {i}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"event {i}: bad dur {dur!r}")
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not all(
+                    isinstance(v, (int, float)) for v in args.values()):
+                raise ValueError(f"event {i}: counter needs numeric args")

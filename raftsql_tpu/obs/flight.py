@@ -1,0 +1,61 @@
+"""Chaos flight recorder: turn an invariant failure into a post-mortem.
+
+The chaos harness already makes every failure REPRODUCIBLE (the seed
+pins the schedule); this makes it READABLE: when an invariant trips,
+the runner dumps the last N ticks of device-plane events plus the
+host-plane spans — the exact per-tick timeline leading into the
+violation — as one JSON artifact next to the failing seed, so a human
+(or a later session) starts from a trace, not from a re-run under a
+debugger.
+
+The dump directory defaults to the current directory and is overridden
+by RAFTSQL_FLIGHT_DIR (tests point it at a tmp dir).  Dump failures
+never mask the invariant error — the recorder logs and returns None.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+log = logging.getLogger("raftsql_tpu.obs.flight")
+
+
+class FlightRecorder:
+    def __init__(self, directory: Optional[str] = None,
+                 last_ticks: int = 64):
+        self.directory = directory or os.environ.get(
+            "RAFTSQL_FLIGHT_DIR", ".")
+        self.last_ticks = last_ticks
+
+    def dump(self, name: str, reason: str, tracer=None, ring=None,
+             meta: Optional[dict] = None) -> Optional[str]:
+        """Write flight-<name>.json; returns the path, or None if the
+        write failed (never raises — the invariant error must win)."""
+        doc = {
+            "reason": reason,
+            "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "meta": meta or {},
+            "device_events": [],
+            "host_spans": {},
+        }
+        try:
+            if ring is not None:
+                ring.drain()
+                doc["device_events"] = ring.rows(last=self.last_ticks)
+            if tracer is not None:
+                doc["host_spans"] = tracer.snapshot()
+        except Exception as e:      # noqa: BLE001 - diagnostics only
+            doc["collect_error"] = repr(e)
+        path = os.path.join(self.directory, f"flight-{name}.json")
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, sort_keys=True)
+        except OSError as e:
+            log.warning("flight-recorder dump to %s failed: %s", path, e)
+            return None
+        log.warning("flight-recorder dump: %s (%s)", path, reason)
+        return path

@@ -211,6 +211,10 @@ class RaftDB:
         self._failed: Optional[Exception] = None
         self._closed = False
         self.latency = LatencyTimer()   # propose→ack, the p50 north star
+        # propose→commit (stamped when the committed entry reaches the
+        # apply consumer — commit + publish, before apply): the
+        # histogram /metrics exports as propose_commit_p50/p95/p99_ms.
+        self.latency_commit = LatencyTimer()
 
         # Synchronous replay consumption (db.go:40): apply until the
         # sentinel so reads see the replayed state before we return.
@@ -221,9 +225,18 @@ class RaftDB:
 
     # ------------------------------------------------------------------
 
-    def _ack_one(self, group: int, query: str, err) -> None:
+    def _node_tracer(self):
+        """The engine's span tracer, or None (tracing may be enabled
+        after construction — resolve per use, it is one getattr)."""
+        return getattr(getattr(self.pipe, "node", None), "tracer", None)
+
+    def _ack_one(self, group: int, query: str, err,
+                 commit_ts: Optional[float] = None) -> None:
         if self.listener is not None:
             self.listener.put((group, query))
+        tracer = self._node_tracer()
+        if tracer is not None:
+            tracer.note_ack(group, query)
         with self._mu:
             cbs = self._q2cb.get((group, query))
             if not cbs:
@@ -233,6 +246,10 @@ class RaftDB:
                 del self._q2cb[(group, query)]
         cb.set(err)
         self.latency.record(time.monotonic() - cb.created)
+        if commit_ts is not None:
+            # commit_ts is when this run was drained off the commit
+            # queue — the commit observation point, before apply.
+            self.latency_commit.record(commit_ts - cb.created)
 
     def _apply_run(self, run) -> None:
         """Apply a drained run of commits with GROUP COMMIT: entries are
@@ -243,6 +260,7 @@ class RaftDB:
         applied index (atomically under its own lock, racing snapshot
         installs safely) and returns None — so skipped-but-committed
         entries still resolve their acks."""
+        commit_ts = time.monotonic()    # commit observation point
         per_g: Dict[int, list] = defaultdict(list)
         for (group, index, query) in run:
             per_g[group].append((query, index))
@@ -254,11 +272,14 @@ class RaftDB:
                 errs[group] = batch_fn(items)
             else:
                 errs[group] = [sm.apply(qy, ix) for (qy, ix) in items]
+        tracer = self._node_tracer()
         pos = {g: 0 for g in per_g}
         for (group, index, query) in run:
             err = errs[group][pos[group]]
             pos[group] += 1
-            self._ack_one(group, query, err)
+            if tracer is not None:
+                tracer.note_apply(group, index)
+            self._ack_one(group, query, err, commit_ts=commit_ts)
         for _ in run:
             self._maybe_compact()
 
@@ -476,17 +497,64 @@ class RaftDB:
         return self._sms[group].query(query)
 
     def metrics(self) -> dict:
+        def ms(v):
+            return round(v * 1e3, 3) if v == v else None   # NaN -> null
+
         m = self.pipe.node.metrics.snapshot()
-        p50 = self.latency.percentile(0.5)
-        p99 = self.latency.percentile(0.99)
-        m["propose_commit_p50_ms"] = round(p50 * 1e3, 3) if p50 == p50 \
-            else None
-        m["propose_commit_p99_ms"] = round(p99 * 1e3, 3) if p99 == p99 \
-            else None
+        # propose→commit (stamped at the commit observation point,
+        # before apply) and propose→ack (after apply, the full
+        # blocking-PUT latency the client sees).
+        c50, c95, c99 = self.latency_commit.percentiles(
+            (0.5, 0.95, 0.99))
+        m["propose_commit_p50_ms"] = ms(c50)
+        m["propose_commit_p95_ms"] = ms(c95)
+        m["propose_commit_p99_ms"] = ms(c99)
+        a50, a99 = self.latency.percentiles((0.5, 0.99))
+        m["propose_ack_p50_ms"] = ms(a50)
+        m["propose_ack_p99_ms"] = ms(a99)
         return m
 
     def render_metrics(self) -> str:
         return json.dumps(self.metrics(), sort_keys=True) + "\n"
+
+    # -- observability exports (raftsql_tpu/obs/) ----------------------
+
+    def trace_doc(self) -> dict:
+        """Chrome trace-event JSON of the engine's span tracer + device
+        event ring (GET /trace; Perfetto-loadable).  Always a valid
+        (possibly empty) document — tracing off just yields no events."""
+        from raftsql_tpu.obs.export import chrome_trace
+        node = self.pipe.node
+        tracer = self._node_tracer()
+        ring = getattr(node, "ring", None)
+        if ring is not None:
+            ring.drain()
+        # Cap the counter window: a long-lived ring (keep=4096 ticks)
+        # would emit ~20 counter events per tick per (peer, group) —
+        # the last 1024 ticks keep the document loadable.
+        return chrome_trace(
+            tracer.snapshot() if tracer is not None else None,
+            ring.rows(last=1024) if ring is not None else None)
+
+    def events_doc(self, last: int = 256) -> dict:
+        """Raw observability state (GET /events): the device ring's
+        drained per-tick rows plus the host tracer's snapshot."""
+        node = self.pipe.node
+        tracer = self._node_tracer()
+        ring = getattr(node, "ring", None)
+        if ring is not None:
+            ring.drain()
+        return {
+            "tracing": tracer is not None or ring is not None,
+            "device": ring.rows(last=last) if ring is not None else [],
+            "host": tracer.snapshot() if tracer is not None else {},
+        }
+
+    def render_trace(self) -> str:
+        return json.dumps(self.trace_doc(), sort_keys=True) + "\n"
+
+    def render_events(self) -> str:
+        return json.dumps(self.events_doc(), sort_keys=True) + "\n"
 
     def close(self) -> Optional[Exception]:
         """Shut down, failing (not leaking) any still-pending acks.

@@ -166,6 +166,12 @@ class FusedClusterNode:
         # inside one C call per publish instead of being materialized as
         # Python bytes for a queue consumer.
         self.native_kv = None
+        # Observability (raftsql_tpu/obs/, OFF by default): a host-plane
+        # span tracer and the on-device event ring.  Every hook below is
+        # gated on these being non-None, so the disabled tick pays one
+        # attribute test and the step signatures are untouched.
+        self.tracer = None
+        self.ring = None
         self.error: Optional[Exception] = None
         self._work_evt = threading.Event()
         self._stop_evt = threading.Event()
@@ -334,10 +340,30 @@ class FusedClusterNode:
         """Last known leader peer (0-based), -1 unknown."""
         return int(self._hints[group])
 
+    def enable_tracing(self, ring_depth: int = 64,
+                       keep: int = 4096) -> None:
+        """Turn on both observability planes (raftsql_tpu/obs/): the
+        host span tracer and the on-device event ring.  Safe to call
+        before the tick loop starts; idempotent."""
+        from raftsql_tpu.obs.device_ring import DeviceEventRing
+        from raftsql_tpu.obs.spans import SpanTracer
+        if self.tracer is None:
+            self.tracer = SpanTracer()
+        if self.ring is None:
+            self.ring = DeviceEventRing(self.cfg.num_peers,
+                                        self.cfg.num_groups,
+                                        depth=ring_depth, keep=keep)
+        for w in self.wals:
+            w.obs = self.tracer
+
     def propose_many(self, group: int, payloads) -> None:
         """Queue payloads at the group's current leader peer (host-side
         routing — all peers share this process; the distributed
         runtime's forward-over-transport becomes a list move)."""
+        if self.tracer is not None:
+            for d in payloads:
+                self.tracer.begin(group,
+                                  d.decode("utf-8", "replace"))
         p = int(self._hints[group])
         if p < 0:
             p = 0
@@ -587,6 +613,17 @@ class FusedClusterNode:
             self.metrics.faults_skew_ticks += int(
                 np.abs(np.asarray(ti, np.int64) - 1).sum())
         pinfo_dev, busy_dev = self._device_step(prop_n, ti)
+        if self.ring is not None:
+            # Device-plane event ring: one extra small fused program
+            # over arrays already resident (tracing-on cost only); the
+            # ring stays on device and drains to host in batches.  A
+            # multi-step dispatch records its final step — the ring is
+            # tick-indexed at dispatch granularity, like the runtime.
+            self.ring.record(self._tick_no,
+                             pinfo_dev if self._steps == 1
+                             else pinfo_dev[-1],
+                             self.states.votes, self.inboxes.v_type,
+                             self.inboxes.a_type, self._applied)
         t1 = _t.monotonic()
         # Overlap: tick t-1's commits are durable (fsynced last tick).
         # Parallel hosts hand them to the publisher worker (the apply
@@ -743,6 +780,13 @@ class FusedClusterNode:
             m_count.extend(sub[:, _C["app_n"]].tolist())
             m_newlen.extend(sub[:, _C["new_log_len"]].tolist())
 
+        if self.tracer is not None and m_peer:
+            # Replicate stamp: the mirrored range is landing in a
+            # follower's log this step (first stamp wins per index).
+            for g, st, c in zip(m_g, m_start, m_count):
+                if c:
+                    self.tracer.note_replicate(g, st + c - 1)
+
         # Phase 2a: leader appends (fresh-leader no-ops + accepted
         # proposals) as uniform-term RANGES per peer: one combined
         # native call writes the WAL records and the payload-log range
@@ -773,6 +817,7 @@ class FusedClusterNode:
             ags = np.nonzero(acc > 0)[0]
             if ags.size:
                 props_p = self._props[p]
+                traced = [] if self.tracer is not None else None
                 with self._prop_lock:   # pops race client-thread extends
                     for g, n, b0, tm in zip(ags.tolist(),
                                             acc[ags].tolist(),
@@ -786,7 +831,15 @@ class FusedClusterNode:
                         r_start.append(b0)
                         r_count.append(n)
                         r_term.append(tm)
+                        if traced is not None:
+                            traced.append((g, b0, batch))
                 self.metrics.proposals += int(acc[ags].sum())
+                if traced:
+                    # Append stamp + index binding, outside the lock.
+                    for g, b0, batch in traced:
+                        self.tracer.note_append(
+                            g, b0, [d.decode("utf-8", "replace")
+                                    for d in batch])
             if not r_g:
                 continue
             tick_active = True
@@ -957,6 +1010,10 @@ class FusedClusterNode:
             ready = np.nonzero(commit > self._applied[p])[0]
             if not ready.size:
                 continue
+            if p == 0 and self.tracer is not None:
+                # Quorum/commit stamp on the client-facing stream.
+                for g, c in zip(ready.tolist(), commit[ready].tolist()):
+                    self.tracer.note_commit(g, int(c))
             if self.publish_peers is not None \
                     and p not in self.publish_peers:
                 # Nobody consumes this peer's stream: advance the

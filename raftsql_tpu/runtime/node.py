@@ -125,6 +125,10 @@ class RaftNode:
         self.commit_q: "queue.Queue" = queue.Queue()
         self.error: Optional[Exception] = None
         self.metrics = NodeMetrics()
+        # Host-plane span tracer (raftsql_tpu/obs/spans.py), OFF by
+        # default; every hook below gates on it so the disabled tick
+        # pays one attribute test (see enable_tracing).
+        self.tracer = None
 
         self._stage_lock = threading.Lock()
         self._stage_votes: Dict[Tuple[int, int], VoteRec] = {}
@@ -327,6 +331,18 @@ class RaftNode:
     # ------------------------------------------------------------------
     # client plane
 
+    def enable_tracing(self) -> None:
+        """Attach the host-plane span tracer (raftsql_tpu/obs/):
+        proposals proposed HERE are followed propose → append →
+        replicate → commit (apply/ack stamps come from the RaftDB
+        layer).  Idempotent."""
+        from raftsql_tpu.obs.spans import SpanTracer
+        if self.tracer is None:
+            self.tracer = SpanTracer()
+        self.wal.obs = self.tracer
+        if hasattr(self.transport, "obs"):
+            self.transport.obs = self.tracer
+
     def propose(self, group: int, payload: bytes) -> None:
         """Enqueue a proposal; routed to the leader on the next tick.
 
@@ -336,6 +352,8 @@ class RaftNode:
         if not 0 <= group < self.cfg.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.cfg.num_groups})")
+        if self.tracer is not None:
+            self.tracer.begin(group, payload.decode("utf-8", "replace"))
         with self._prop_lock:
             self._props[group].append(wrap(payload))
             self._prop_len[group] += 1
@@ -349,6 +367,9 @@ class RaftNode:
         if not 0 <= group < self.cfg.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.cfg.num_groups})")
+        if self.tracer is not None:
+            for p in payloads:
+                self.tracer.begin(group, p.decode("utf-8", "replace"))
         wrapped = [wrap(p) for p in payloads]
         with self._prop_lock:
             self._props[group].extend(wrapped)
@@ -973,6 +994,13 @@ class RaftNode:
                         zip(range(base + 1, base + 1 + n_acc), batch))
                     self.payload_log.put(g, base + 1, batch,
                                          [t_g] * n_acc)
+                    if self.tracer is not None:
+                        # Bind spans to their log indexes (envelope
+                        # stripped — spans are keyed by plain content).
+                        self.tracer.note_append(
+                            g, base + 1,
+                            [unwrap(p)[1].decode("utf-8", "replace")
+                             for p in batch])
                 self.metrics.proposals += n_acc
             src = int(app_from[g])
             if src >= 0:
@@ -1099,6 +1127,8 @@ class RaftNode:
                 continue
             prev_term, ents = got
             self._catchup_sent[(g, d)] = (ni, self._tick_no)
+            if self.tracer is not None and ents:
+                self.tracer.note_replicate(g, ni - 1 + len(ents))
             out[(g, d)] = AppendRec(
                 group=g, type=MSG_REQ, term=int(term[g]),
                 prev_idx=ni - 1, prev_term=prev_term,
@@ -1204,6 +1234,10 @@ class RaftNode:
                 payloads = self.payload_log.try_slice(g, prev + 1, n)
                 if payloads is None:
                     continue
+                if self.tracer is not None and n:
+                    # Replicate stamp: the entries left for a follower
+                    # (first transmission wins per index).
+                    self.tracer.note_replicate(g, prev + n)
                 batch_for(d).appends.append(AppendRec(
                     group=g, type=MSG_REQ, term=tm,
                     prev_idx=prev, prev_term=pt,
@@ -1315,6 +1349,8 @@ class RaftNode:
         for g in ready.tolist():
             c = int(commit[g])
             a = int(self._applied[g])
+            if self.tracer is not None:
+                self.tracer.note_commit(g, c)
             fwd = self._fwd[g]
             # One locked read for the whole newly-committed range — a
             # per-entry get() pays a lock acquisition per entry, which

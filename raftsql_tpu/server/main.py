@@ -49,7 +49,8 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
                tick: float = 0.01, election_ticks: int | None = None,
                data_prefix: str = "raftsql", resume: bool = False,
                compact_every: int = 0, compact_keep: int = 1024,
-               wal_segment_bytes: int = 4 << 20) -> RaftDB:
+               wal_segment_bytes: int = 4 << 20,
+               trace: bool = False) -> RaftDB:
     peers = cluster.split(",")
     # Default election/heartbeat timing is REAL-TIME parity with the
     # reference (~1 s election timeout, ~100 ms heartbeat at its 100 ms
@@ -77,6 +78,8 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
     transport = TcpTransport(peers, node_id - 1)
     pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
                            data_dir=f"{data_prefix}-{node_id}")
+    if trace:
+        pipe.node.enable_tracing()
 
     def sm_factory(g: int) -> SQLiteStateMachine:
         path = (f"{data_prefix}-{node_id}.db" if g == 0
@@ -92,7 +95,8 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                      data_prefix: str = "raftsql",
                      resume: bool = False,
                      compact_every: int = 0, compact_keep: int = 1024,
-                     wal_segment_bytes: int = 4 << 20) -> RaftDB:
+                     wal_segment_bytes: int = 4 << 20,
+                     trace: bool = False) -> RaftDB:
     """The --fused single-process deployment: all P peers of every
     group co-located in THIS process, consensus advanced by ONE fused
     device program per tick (runtime/fused.py), per-peer WALs on disk,
@@ -106,6 +110,8 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                      tick_interval_s=tick,
                      wal_segment_bytes=wal_segment_bytes)
     node = FusedClusterNode(cfg, f"{data_prefix}-fused")
+    if trace:
+        node.enable_tracing()
     node.start(interval_s=max(tick, 0.0005))
     pipe = FusedPipe(node)
 
@@ -153,6 +159,11 @@ def main(argv=None) -> None:
                     help="HTTP plane: single-thread event loop with "
                          "batched commit acks (aio, default) or the "
                          "thread-per-connection stdlib port (threaded)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable the observability planes "
+                         "(raftsql_tpu/obs/): per-proposal lifecycle "
+                         "spans + the on-device event ring, exported "
+                         "at GET /trace (Perfetto) and GET /events")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -177,13 +188,15 @@ def main(argv=None) -> None:
                                tick=args.tick, resume=args.resume,
                                compact_every=args.compact_every,
                                compact_keep=args.compact_keep,
-                               wal_segment_bytes=args.wal_segment_bytes)
+                               wal_segment_bytes=args.wal_segment_bytes,
+                               trace=args.trace)
     else:
         rdb = build_node(args.cluster, args.id, groups=args.groups,
                          tick=args.tick, resume=args.resume,
                          compact_every=args.compact_every,
                          compact_keep=args.compact_keep,
-                         wal_segment_bytes=args.wal_segment_bytes)
+                         wal_segment_bytes=args.wal_segment_bytes,
+                         trace=args.trace)
     if args.http_engine == "aio":
         from raftsql_tpu.api.aio import AioSQLServer
         AioSQLServer(args.port, rdb).serve_forever()

@@ -259,6 +259,11 @@ class WAL:
         self._f = None
         self._pending = False
         self.last_sync_s = 0.0
+        # Observability hook (raftsql_tpu/obs/spans.py SpanTracer, or
+        # anything with note_event): wired by the owning runtime's
+        # enable_tracing so every durable barrier lands on the host
+        # trace timeline.  None (default) costs one attribute test.
+        self.obs = None
         # A crash can tear the active segment's tail.  Appending AFTER
         # torn bytes would hide every later record from replay (it stops
         # at the first bad CRC) — durably-acked writes would vanish on the
@@ -629,6 +634,9 @@ class WAL:
         else:
             fsio.fsync_file(self._f)
         self.last_sync_s = _t.monotonic() - t0
+        if self.obs is not None:
+            self.obs.note_event("wal.fsync", dur_s=self.last_sync_s,
+                                dir=self.dirname)
         self._pending = False
         if self._bytes >= self.segment_bytes:
             self._rotate()

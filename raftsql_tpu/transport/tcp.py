@@ -207,6 +207,10 @@ class TcpTransport(Transport):
         # Injectable send-side fault seam (chaos harness); None in
         # production.
         self.faults: Optional[SendFaults] = None
+        # Observability hook (raftsql_tpu/obs/ SpanTracer.note_event or
+        # compatible), wired by the node's enable_tracing: frame
+        # send/recv instants land on the host trace timeline.
+        self.obs = None
         self._stop_evt = threading.Event()
         self._senders: Dict[int, _PeerSender] = {}
         self._listener: Optional[socket.socket] = None
@@ -285,6 +289,9 @@ class TcpTransport(Transport):
                         log.warning("dropping corrupt frame from src %d "
                                     "(%d bytes): %s", src, plen, e)
                         continue
+                    if self.obs is not None:
+                        self.obs.note_event("tcp.recv", src=src,
+                                            n_bytes=plen)
                     self._deliver(src, batch)
                 try:
                     chunk = conn.recv(1 << 16)
@@ -305,6 +312,8 @@ class TcpTransport(Transport):
         if sender is None:
             return
         blob = encode_batch_framed(batch)
+        if self.obs is not None:
+            self.obs.note_event("tcp.send", dst=dst, n_bytes=len(blob))
         if self.faults is not None:
             got = self.faults.apply(dst, blob)
             if got is None:

@@ -111,8 +111,18 @@ class LatencyTimer:
                 self._next = (self._next + 1) % self._cap
 
     def percentile(self, q: float) -> float:
+        return self.percentiles((q,))[0]
+
+    def percentiles(self, qs) -> list:
+        """Percentile per q in `qs`, from ONE snapshot + sort.
+
+        The copy happens under the lock; the O(n log n) sort does NOT —
+        a /metrics scrape sorting 4096 samples inside the lock would
+        stall every record() on the tick hot path for the duration.
+        NaN when empty."""
         with self._lock:
-            if not self._samples:
-                return float("nan")
-            s = sorted(self._samples)
-            return s[min(int(q * len(s)), len(s) - 1)]
+            s = list(self._samples)
+        if not s:
+            return [float("nan")] * len(qs)
+        s.sort()
+        return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]

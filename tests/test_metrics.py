@@ -1,0 +1,89 @@
+"""LatencyTimer (utils/metrics.py): ring wraparound, percentile edge
+cases, and the record/percentile locking contract — percentile must
+copy under the lock and sort OUTSIDE it, so a /metrics scrape can never
+stall record() on the tick hot path."""
+import math
+import threading
+
+from raftsql_tpu.utils.metrics import LatencyTimer
+
+
+def test_empty_percentile_is_nan():
+    t = LatencyTimer()
+    assert math.isnan(t.percentile(0.5))
+    assert all(math.isnan(v) for v in t.percentiles((0.0, 0.5, 1.0)))
+
+
+def test_single_sample_every_quantile():
+    t = LatencyTimer()
+    t.record(0.25)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert t.percentile(q) == 0.25
+
+
+def test_q_one_is_max_and_q_zero_is_min():
+    t = LatencyTimer(cap=16)
+    for v in (5.0, 1.0, 3.0, 2.0):
+        t.record(v)
+    assert t.percentile(0.0) == 1.0
+    # q=1.0 indexes past the end without the clamp; must be the max.
+    assert t.percentile(1.0) == 5.0
+
+
+def test_ring_wraparound_past_cap_keeps_recent_samples():
+    cap = 8
+    t = LatencyTimer(cap=cap)
+    for i in range(30):                       # 30 > 3 * cap
+        t.record(float(i))
+    assert len(t._samples) == cap
+    # Ring semantics: only the newest `cap` samples survive, so the
+    # minimum percentile can never reach the overwritten early values.
+    assert t.percentile(0.0) >= 30 - cap
+    assert t.percentile(1.0) == 29.0
+
+
+def test_percentiles_one_snapshot_many_quantiles():
+    t = LatencyTimer(cap=64)
+    for i in range(50):
+        t.record(float(i))
+    p50, p95, p99 = t.percentiles((0.5, 0.95, 0.99))
+    assert p50 == 25.0 and p95 == 47.0 and p99 == 49.0
+
+
+def test_concurrent_record_and_percentile_smoke():
+    """Writers hammer record() while readers take percentiles: no
+    exception, no deadlock, and the ring stays bounded."""
+    t = LatencyTimer(cap=128)
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                t.record(i * 1e-6)
+                i += 1
+        except Exception as e:                # noqa: BLE001
+            errs.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                t.percentiles((0.5, 0.95, 0.99))
+        except Exception as e:                # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] \
+        + [threading.Thread(target=reader) for _ in range(2)]
+    for th in threads:
+        th.start()
+    timer = threading.Timer(0.5, stop.set)
+    timer.start()
+    for th in threads:
+        th.join(timeout=10)
+    timer.cancel()
+    assert not errs, errs[:1]
+    assert not any(th.is_alive() for th in threads)
+    assert len(t._samples) <= 128
+    p = t.percentile(0.5)
+    assert p == p                             # a real number by now
