@@ -4,7 +4,7 @@
 PY ?= python
 SEED ?= 0
 
-.PHONY: all native test vet bench chaos trace clean
+.PHONY: all native test vet bench chaos chaos-membership trace clean
 
 # "Build" = compile the native C++ components (storage fast path).
 all: native
@@ -46,6 +46,16 @@ chaos:
 chaos-matrix:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --matrix --seed $(SEED)
+
+# Membership-churn chaos (raftsql_tpu/membership/): SIGKILL a voter,
+# boot a fresh spare, add-learner -> promote (joint consensus) ->
+# remove the dead member, under drops + a second crash.  Deterministic:
+# runs the seed twice and digest-compares, and every invariant
+# (including "no quorum from a removed majority") must hold.
+#   make chaos-membership SEED=17
+chaos-membership:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --family membership --seed $(SEED)
 
 # Observability demo (raftsql_tpu/obs/): run a traced fused cluster and
 # emit Chrome trace-event JSON — load trace.json at ui.perfetto.dev or

@@ -149,8 +149,17 @@ class _Conn(asyncio.Protocol):
                     self.tr.write(_resp(200, b"OK", render().encode(),
                                         b"application/json"))
                     continue
+                if path == b"/members":
+                    # Membership admin read — parity with api/http.py.
+                    self.tr.write(_resp(
+                        200, b"OK", self.srv.rdb.render_members()
+                        .encode(), b"application/json"))
+                    continue
                 self.busy = True
                 self.srv.loop.create_task(self._do_get(headers, body))
+            elif method == b"POST" and path == b"/members":
+                self.busy = True
+                self.srv.loop.create_task(self._do_members(body))
             elif method == b"HEAD":
                 self.tr.write(_ALLOW_NOBODY)
             else:
@@ -252,6 +261,34 @@ class _Conn(asyncio.Protocol):
                                (str(err) + "\n").encode()))
         else:
             self._finish(_204)
+
+    async def _do_members(self, body: bytes) -> None:
+        """POST /members — membership admin write, parity with
+        api/http.py: 200 + new config JSON, 421 + X-Raft-Leader at a
+        non-leader, 400 on an illegal change."""
+        import json as _json
+        rdb = self.srv.rdb
+        try:
+            req = _json.loads(body.decode("utf-8") or "{}")
+            got = await self.srv.loop.run_in_executor(
+                self.srv._read_pool,
+                lambda: rdb.member_change(int(req.get("group", 0)),
+                                          str(req.get("op", "")),
+                                          int(req.get("peer", -1))))
+        except NotLeaderError as e:
+            extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
+                if e.leader > 0 else ()
+            self._finish(_resp(421, b"Misdirected Request",
+                               (str(e) + "\n").encode(), extra=extra))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        self._finish(_resp(200, b"OK",
+                           (_json.dumps(got, sort_keys=True)
+                            + "\n").encode(), b"application/json"))
 
     async def _do_get(self, headers: dict, body: bytes) -> None:
         rdb = self.srv.rdb

@@ -21,6 +21,7 @@ Extensions beyond the reference (multi-group engine):
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -102,6 +103,13 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(200, rdb.render_events().encode(),
                            ctype="application/json")
                 return
+            if self.path == "/members":
+                # Membership admin read (raftsql_tpu/membership/):
+                # per-group active config, joint state, leader hint.
+                self._body()    # drain — keep-alive
+                self._send(200, rdb.render_members().encode(),
+                           ctype="application/json")
+                return
             try:
                 linear = (self.headers.get("X-Consistency", "")
                           .lower() == "linear")
@@ -138,7 +146,31 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             if body:
                 self.wfile.write(body)
 
-        do_POST = _method_not_allowed
+        def do_POST(self):
+            # Membership admin write: POST /members
+            # {"group": 0, "op": "add|add_learner|promote|remove|
+            #  remove_learner", "peer": <slot>}.  Leader-only: elsewhere
+            # answers 421 + X-Raft-Leader like linearizable reads.
+            if self.path != "/members":
+                self._method_not_allowed()
+                return
+            try:
+                req = json.loads(self._body() or "{}")
+                got = rdb.member_change(int(req.get("group", 0)),
+                                        str(req.get("op", "")),
+                                        int(req.get("peer", -1)))
+            except NotLeaderError as e:
+                self._send(421, (str(e) + "\n").encode("utf-8"),
+                           headers={"X-Raft-Leader": str(e.leader)}
+                           if e.leader > 0 else None)
+                return
+            except Exception as e:
+                self._err(e)
+                return
+            self._send(200, (json.dumps(got, sort_keys=True)
+                             + "\n").encode(),
+                       ctype="application/json")
+
         do_DELETE = _method_not_allowed
         do_PATCH = _method_not_allowed
         do_HEAD = _method_not_allowed

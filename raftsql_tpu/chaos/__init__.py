@@ -30,37 +30,48 @@ see the README's fault-matrix table.
 from raftsql_tpu.chaos.invariants import (DurabilityLedger, ElectionSafety,
                                           InvariantViolation,
                                           RegisterLinearizability,
+                                          RemovedQuorumSafety,
                                           check_convergence)
 from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
                                         ChaosSchedule, CorruptWindow,
                                         CrashEvent, DelayWindow, DropWindow,
                                         EnospcFault, FsyncFault, FsyncStall,
-                                        NodeChaosPlan, NodeCrash,
+                                        MemberEvent, MembershipChaosPlan,
+                                        NodeBoot, NodeChaosPlan, NodeCrash,
                                         PartitionWindow, SkewWindow,
-                                        TcpChaosPlan, TornWriteFault,
+                                        TcpChaosPlan, TcpRebindPlan,
+                                        TornWriteFault,
                                         generate, generate_asym,
                                         generate_compact,
                                         generate_corrupt_plan,
-                                        generate_enospc, generate_node_plan,
+                                        generate_enospc,
+                                        generate_membership_plan,
+                                        generate_node_plan,
                                         generate_skew,
                                         generate_snapshot_plan,
-                                        generate_stall, generate_tcp_plan)
+                                        generate_stall, generate_tcp_plan,
+                                        generate_tcp_rebind_plan)
 from raftsql_tpu.chaos.scenarios import (FusedChaosRunner,
+                                         MembershipChaosRunner,
                                          NodeClusterChaosRunner,
                                          SnapshotChaosRunner,
-                                         TcpClusterChaosRunner)
+                                         TcpClusterChaosRunner,
+                                         TcpRebindChaosRunner)
 
 __all__ = [
     "LEADER_TARGET", "AsymPartitionWindow", "ChaosSchedule",
     "CorruptWindow", "CrashEvent", "DelayWindow", "DropWindow",
-    "EnospcFault", "FsyncFault", "FsyncStall", "NodeChaosPlan",
+    "EnospcFault", "FsyncFault", "FsyncStall", "MemberEvent",
+    "MembershipChaosPlan", "NodeBoot", "NodeChaosPlan",
     "NodeCrash", "PartitionWindow", "SkewWindow", "TcpChaosPlan",
-    "TornWriteFault", "generate", "generate_asym", "generate_compact",
-    "generate_corrupt_plan", "generate_enospc", "generate_node_plan",
+    "TcpRebindPlan", "TornWriteFault", "generate", "generate_asym",
+    "generate_compact", "generate_corrupt_plan", "generate_enospc",
+    "generate_membership_plan", "generate_node_plan",
     "generate_skew", "generate_snapshot_plan", "generate_stall",
-    "generate_tcp_plan",
+    "generate_tcp_plan", "generate_tcp_rebind_plan",
     "DurabilityLedger", "ElectionSafety", "InvariantViolation",
-    "RegisterLinearizability", "check_convergence", "FusedChaosRunner",
+    "RegisterLinearizability", "RemovedQuorumSafety",
+    "check_convergence", "FusedChaosRunner", "MembershipChaosRunner",
     "NodeClusterChaosRunner", "SnapshotChaosRunner",
-    "TcpClusterChaosRunner",
+    "TcpClusterChaosRunner", "TcpRebindChaosRunner",
 ]

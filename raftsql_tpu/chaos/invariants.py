@@ -181,6 +181,57 @@ def check_convergence(group: int, survivors: List[Tuple[int, int, Dict]],
                 f"{survivors[0][1]}")
 
 
+class RemovedQuorumSafety:
+    """NO QUORUM FROM A REMOVED MAJORITY (dynamic membership,
+    raftsql_tpu/membership/): every observed leader must be a voter of
+    its OWN node's active configuration.  Leadership requires a quorum
+    of vote grants; grantors only grant to peers they believe are
+    voters (core/step.py voter_src gate) and tallies count only voters
+    (mask-weighted quorum) — so once a removal has applied at a
+    majority, the removed peers can never again assemble a quorum, and
+    a leader observed outside its own config means exactly that
+    property broke.  Additionally, once EVERY live node's applied
+    config excludes a peer from group g, that peer must never be
+    observed leading g at any later tick (covers a stale-config node
+    trying to lead on the strength of other removed peers)."""
+
+    def __init__(self, leader_code: int = 2):
+        self._leader_code = leader_code
+        # (group) -> set of peers fully removed (excluded by every live
+        # node's applied config at some earlier observation).
+        self._fully_removed: Dict[int, set] = {}
+        self.observations = 0
+
+    def observe(self, tick: int, roles: np.ndarray, voter_of,
+                live_configs) -> None:
+        """roles: [P, G] (dead rows < 0).  voter_of(p, g) -> bool: is p
+        a voter (either joint mask) of NODE p's own applied config.
+        live_configs: iterable of per-node (voters|joint) bitmask
+        getters `fn(g) -> int` for live nodes (used for the
+        fully-removed tracking)."""
+        self.observations += 1
+        P, G = roles.shape
+        lead_p, lead_g = np.nonzero(roles == self._leader_code)
+        for p, g in zip(lead_p.tolist(), lead_g.tolist()):
+            if not voter_of(p, g):
+                raise InvariantViolation(
+                    f"t={tick} g={g}: peer {p} leads but is not a "
+                    f"voter of its own applied configuration")
+            if p in self._fully_removed.get(g, ()):
+                raise InvariantViolation(
+                    f"t={tick} g={g}: REMOVED peer {p} regained "
+                    f"leadership — a removed majority formed a quorum")
+        fns = list(live_configs)
+        if not fns:
+            return
+        for g in range(G):
+            masks = [fn(g) for fn in fns]
+            excluded = {p for p in range(P)
+                        if all(not (m >> p & 1) for m in masks)}
+            if excluded:
+                self._fully_removed.setdefault(g, set()).update(excluded)
+
+
 class RegisterLinearizability:
     """Per-key register linearizability over completed PUT/GET history.
 

@@ -23,6 +23,9 @@ matrix (ROADMAP open items → chaos/schedule.py generators):
     compact          compaction + crash interleaving   (fused plane)
     snapshot         compaction + InstallSnapshot + crash (lockstep plane)
     tcp              drops/corruption/asym/delays      (REAL TCP transport)
+    membership       add/promote/remove churn + node replacement under
+                     faults (lockstep plane, raftsql_tpu/membership/)
+    tcp_rebind       crash/restart with port rebinding (REAL TCP transport)
 
 Every family except `tcp` is run twice and must reproduce identical
 schedule + result digests.  The TCP family crosses real kernel sockets,
@@ -57,9 +60,11 @@ def _check(ok: bool, msg: str) -> bool:
 # family -> (runner, deterministic, fired_predicate)
 def _family_specs():
     from raftsql_tpu.chaos import schedule as S
-    from raftsql_tpu.chaos.scenarios import (NodeClusterChaosRunner,
+    from raftsql_tpu.chaos.scenarios import (MembershipChaosRunner,
+                                             NodeClusterChaosRunner,
                                              SnapshotChaosRunner,
-                                             TcpClusterChaosRunner)
+                                             TcpClusterChaosRunner,
+                                             TcpRebindChaosRunner)
 
     def node_run(runner_cls, plan):
         with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
@@ -88,6 +93,17 @@ def _family_specs():
                                       S.generate_tcp_plan(seed)),
                 False, lambda r: r["corrupt_frames_dropped"] > 0
                 and r["commits"] > 20),
+        "membership": (lambda seed: node_run(
+                           MembershipChaosRunner,
+                           S.generate_membership_plan(seed)),
+                       True, lambda r: r["member_ops_applied"]
+                       >= 2 * 3 and r["boots"] >= 1
+                       and r["crashes"] >= 2 and r["commits"] > 20),
+        "tcp_rebind": (lambda seed: node_run(
+                           TcpRebindChaosRunner,
+                           S.generate_tcp_rebind_plan(seed)),
+                       False, lambda r: r["rebinds"] == 2
+                       and r["commits"] > 20),
     }
 
 

@@ -2,7 +2,7 @@
 `ChaosSchedule`, injecting faults at every seam, checking invariants
 every tick.
 
-Four runners, covering three planes:
+Six runners, covering three planes:
 
   * `FusedChaosRunner` — the fused single-dispatch runtime
     (runtime/fused.py FusedClusterNode).  Fully deterministic: one
@@ -25,6 +25,15 @@ Four runners, covering three planes:
     transport (transport/tcp.py) with its injectable send-side fault
     seam: drops, one-directional blocks, frame corruption (CRC-dropped
     and counted at the receivers), delayed frames.
+  * `MembershipChaosRunner` — dynamic-membership churn on the lockstep
+    plane (raftsql_tpu/membership/): permanent SIGKILL + fresh-machine
+    replacement via add-learner -> promote (joint consensus) ->
+    remove-dead, under drops/partitions/crashes, with the
+    RemovedQuorumSafety invariant and a final-config convergence +
+    progress check.
+  * `TcpRebindChaosRunner` — TCP-plane crash/restart with PORT
+    REBINDING: listeners close, the same ports are rebound on restart,
+    peers must reconnect and the restarted node must catch up.
 
 Crash simulation ("hard crash"): every open durable fd of the dying
 node is redirected to /dev/null before the object is abandoned — a
@@ -54,10 +63,12 @@ from raftsql_tpu.chaos.invariants import (CommitMonotonic,
                                           DurabilityLedger, ElectionSafety,
                                           InvariantViolation,
                                           RegisterLinearizability,
+                                          RemovedQuorumSafety,
                                           check_convergence,
                                           check_log_matching)
 from raftsql_tpu.chaos.schedule import (LEADER_TARGET, ChaosSchedule,
-                                        NodeChaosPlan, TcpChaosPlan)
+                                        MembershipChaosPlan, NodeChaosPlan,
+                                        TcpChaosPlan, TcpRebindPlan)
 from raftsql_tpu.config import LEADER, RaftConfig
 from raftsql_tpu.runtime.db import _expand_commit_item, iter_plain_batches
 from raftsql_tpu.runtime.fused import FusedClusterNode
@@ -564,6 +575,10 @@ class NodeClusterChaosRunner:
                        "asym_partitions": 0, "skew_ticks": 0,
                        "corrupt_frames": 0, "commits": 0}
         self._asym_src: Dict[int, int] = {}
+        # Peer slots that start UNBOOTED (provisioned spare capacity,
+        # membership plans): slot -> first boot tick.  The restart path
+        # then boots them fresh — "a new machine joins".
+        self._initial_down: Dict[int, int] = {}
         self._t = 0
         # Wire-corruption seam: mangle encoded frames during the plan's
         # corruption windows; the CRC framing must catch every mangled
@@ -599,6 +614,10 @@ class NodeClusterChaosRunner:
         pass
 
     def _apply_commit(self, p: int, g: int, idx: int, sql: str) -> None:
+        pass
+
+    def _pre_tick(self, t: int, healing: bool,
+                  rng: np.random.Generator) -> None:
         pass
 
     def _post_tick(self, t: int, healing: bool) -> None:
@@ -704,7 +723,8 @@ class NodeClusterChaosRunner:
         total = self.plan.ticks + self.plan.heal_ticks
         with fsio.installed(inj):
             for p in range(self.P):
-                self.nodes[p] = self._boot(p)
+                if p not in self._initial_down:
+                    self.nodes[p] = self._boot(p)
             try:
                 for t in range(total):
                     self._t = t
@@ -725,6 +745,14 @@ class NodeClusterChaosRunner:
                         del down_until[p]
                         self.nodes[p] = self._boot(p)
                         self.report["restarts"] += 1
+                    for p in [p for p, bt in self._initial_down.items()
+                              if bt <= t]:
+                        # Provisioned spare slot comes online: a FRESH
+                        # machine (empty WAL) joining the cluster.
+                        del self._initial_down[p]
+                        self.nodes[p] = self._boot(p)
+                        self.report["boots"] = \
+                            self.report.get("boots", 0) + 1
                     self.hub.faults.heal()
                     incs: Optional[Tuple[int, ...]] = None
                     if not healing:
@@ -745,6 +773,11 @@ class NodeClusterChaosRunner:
                         for w in self.plan.skews:
                             if w.start <= t < w.end:
                                 incs = w.incs
+                    # Subclass seam (membership runner: seeded per-link
+                    # drops, scripted admin churn).  Draw order is fixed,
+                    # so determinism survives the hook.
+                    self._pre_tick(t, healing, rng)
+                    if not healing:
                         if rng.random() < self.plan.prop_rate:
                             alive = [p for p, n in enumerate(self.nodes)
                                      if n is not None]
@@ -1025,4 +1058,353 @@ class TcpClusterChaosRunner:
         self.report["corrupt_frames_dropped"] = sum(
             n.metrics.faults_corrupt_frames for n in self.nodes
             if n is not None)
+        return {"plan_digest": self.plan.digest(), **self.report}
+
+
+class MembershipChaosRunner(NodeClusterChaosRunner):
+    """Dynamic-membership churn under faults (raftsql_tpu/membership/).
+
+    The node-replacement story, scripted by a MembershipChaosPlan: a
+    cluster booted on `initial_voters` over P provisioned slots loses a
+    voter to a permanent SIGKILL, boots a spare slot as a FRESH machine
+    (empty WAL), adds it as a learner, promotes it through joint
+    consensus once caught up, and removes the dead member — while
+    drops, partitions, and transient crashes land mid-churn.  Admin ops
+    are issued against the group's current leader and retried every
+    tick until the applied configuration reflects them (exactly an
+    operator's retry loop, including aborting a change whose entry was
+    lost with its leader).
+
+    On top of the base invariants (single leader per term, per-node
+    durability across restart, log matching, commit monotonicity) every
+    tick observes RemovedQuorumSafety — no quorum from a removed
+    majority — and the final check asserts every live node converged on
+    `plan.final_voters` with zero learners AND that the cluster still
+    commits on the post-churn configuration.  Fully deterministic
+    (lockstep ticks, seeded draws): two runs of one plan must produce
+    identical result digests.
+    """
+
+    # Abort-and-reissue horizon for an admin op whose conf entry was
+    # lost (leader died holding the one-in-flight latch, proposal
+    # dropped): an operator timeout, in ticks.
+    RETRY_TICKS = 60
+
+    def __init__(self, plan: MembershipChaosPlan, tmpdir: str):
+        cfg = RaftConfig(
+            num_groups=2, num_peers=plan.peers, log_window=64,
+            max_entries_per_msg=4, election_ticks=10, heartbeat_ticks=1,
+            tick_interval_s=0.0, initial_voters=plan.initial_voters)
+        super().__init__(plan, tmpdir, cfg=cfg, peers=plan.peers)
+        for b in plan.boots:
+            self._initial_down[b.peer] = b.tick
+        self.removed_safety = RemovedQuorumSafety(LEADER)
+        self._events = sorted(plan.events, key=lambda e: e.tick)
+        G = self.cfg.num_groups
+        self._ev_done = [0] * G          # per-group next-event cursor
+        # g -> (node the pending op was issued at, issue tick).
+        self._issued: Dict[int, Tuple[int, int]] = {}
+        # report["commits"] at the moment every group settled on the
+        # final config — progress after this point proves the new
+        # voter set actually commits.
+        self._settle_commits: Optional[int] = None
+        self.report.update({"boots": 0, "member_ops_applied": 0,
+                            "member_op_retries": 0,
+                            "member_op_aborts": 0})
+
+    # -- scripted admin churn ------------------------------------------
+
+    def _op_complete(self, g: int, op: str, peer: int) -> bool:
+        """The applied config of some live node reflects the op and the
+        group left its joint state (replication spreads it from there;
+        the next op validates against the leader's view anyway)."""
+        for n in self.nodes:
+            if n is None or n.membership is None:
+                continue
+            c = n.membership.config(g)
+            if c.is_joint:
+                continue
+            bit = 1 << peer
+            if op == "add_learner" and c.learners & bit:
+                return True
+            if op == "promote" and c.voters & bit \
+                    and not c.learners & bit:
+                return True
+            if op == "remove" and c.index > 0 \
+                    and not (c.voters | c.joint) & bit:
+                return True
+            if op == "remove_learner" and c.index > 0 \
+                    and not c.learners & bit:
+                return True
+        return False
+
+    def _leader_node(self, g: int) -> Optional[int]:
+        for p, n in enumerate(self.nodes):
+            if n is not None and n._last_role[g] == LEADER:
+                return p
+        return None
+
+    def _drive_events(self, t: int) -> None:
+        from raftsql_tpu.membership import MembershipError
+        for g in range(self.cfg.num_groups):
+            i = self._ev_done[g]
+            if i >= len(self._events):
+                continue
+            ev = self._events[i]
+            if t < ev.tick:
+                continue
+            if self._op_complete(g, ev.op, ev.peer):
+                self._ev_done[g] += 1
+                self._issued.pop(g, None)
+                self.report["member_ops_applied"] += 1
+                continue
+            lead = self._leader_node(g)
+            if lead is None:
+                continue
+            try:
+                self.nodes[lead].member_change(g, ev.op, ev.peer)
+                self._issued[g] = (lead, t)
+            except MembershipError:
+                # Not caught up yet / change in flight / transient
+                # joint state: the operator retry loop.  If the latch
+                # holder sat on an in-flight change past the horizon
+                # (its conf entry died with a deposed leader), abort it
+                # there and reissue fresh.
+                self.report["member_op_retries"] += 1
+                src_t = self._issued.get(g)
+                if src_t is not None \
+                        and t - src_t[1] > self.RETRY_TICKS:
+                    src = self.nodes[src_t[0]]
+                    if src is not None and src.membership is not None:
+                        src.membership.abort_pending(g)
+                        self.report["member_op_aborts"] += 1
+                    self._issued[g] = (src_t[0], t)
+
+    def _pre_tick(self, t: int, healing: bool,
+                  rng: np.random.Generator) -> None:
+        if not healing:
+            # Per-link drop windows: the loopback hub has no rate seam,
+            # so each active window blocks a seeded subset of directed
+            # links for THIS tick (heal() lifts them next tick).  Draw
+            # count per tick is fixed — determinism holds.
+            for w in self.plan.drops:
+                if w.start <= t < w.end:
+                    for s in range(self.P):
+                        for d in range(self.P):
+                            if s != d and rng.random() < w.p:
+                                self.hub.faults.block(s + 1, d + 1)
+        self._drive_events(t)
+        if healing and self._needs_settle_load():
+            # Keep a trickle of writes flowing until the post-churn
+            # config has demonstrably committed (the heal window's
+            # no-new-load rule bends exactly this far: proving the
+            # final voter set commits IS the recovery being waited on).
+            for g in range(self.cfg.num_groups):
+                lead = self._leader_node(g)
+                if lead is not None:
+                    self.nodes[lead].propose(
+                        g, f"SET settle{g} t{t}".encode())
+
+    def _needs_settle_load(self) -> bool:
+        return self._settle_commits is None \
+            or self.report["commits"] <= self._settle_commits + 5
+
+    # -- invariants ----------------------------------------------------
+
+    def _final_mask(self) -> int:
+        want = 0
+        for v in self.plan.final_voters:
+            want |= 1 << v
+        return want
+
+    def _post_tick(self, t: int, healing: bool) -> None:
+        if self._settle_commits is not None:
+            return
+        if any(i < len(self._events) for i in self._ev_done):
+            return
+        want = self._final_mask()
+        for n in self.nodes:
+            if n is None or n.membership is None:
+                continue
+            for g in range(self.cfg.num_groups):
+                c = n.membership.config(g)
+                if c.is_joint or c.voters != want:
+                    return
+        self._settle_commits = self.report["commits"]
+
+    def _observe(self, t: int) -> None:
+        super()._observe(t)
+        G = self.cfg.num_groups
+        roles = np.full((self.P, G), DEAD_ROLE, np.int64)
+        for p, n in enumerate(self.nodes):
+            if n is not None:
+                roles[p] = n._last_role
+
+        def voter_of(p: int, g: int) -> bool:
+            n = self.nodes[p]
+            return n is not None and n.membership is not None \
+                and bool(n.membership.voter_mask(g) >> p & 1)
+
+        live = [n.membership.voter_mask for n in self.nodes
+                if n is not None and n.membership is not None]
+        self.removed_safety.observe(t, roles, voter_of, live)
+
+    def _final_check(self) -> None:
+        want = self._final_mask()
+        for g in range(self.cfg.num_groups):
+            for p, n in enumerate(self.nodes):
+                if n is None or n.membership is None:
+                    continue
+                c = n.membership.config(g)
+                if c.is_joint or c.voters != want or c.learners:
+                    raise InvariantViolation(
+                        f"post-heal g={g}: node {p} ended on "
+                        f"voters={c.voters:#x} joint={c.is_joint} "
+                        f"learners={c.learners:#x}, wanted "
+                        f"voters={want:#x} stable")
+        if self._settle_commits is None:
+            raise InvariantViolation(
+                "the scripted membership churn never completed: "
+                f"per-group event cursors {self._ev_done} of "
+                f"{len(self._events)}")
+        if self.report["commits"] <= self._settle_commits:
+            raise InvariantViolation(
+                "no commits observed on the post-churn configuration "
+                f"(stuck at {self._settle_commits})")
+
+
+class TcpRebindChaosRunner:
+    """TCP-plane crash/restart with PORT REBINDING (the ROADMAP chaos
+    frontier item): a TcpRebindPlan stops nodes — their listeners
+    close, their ports are released — and restarts each on the SAME
+    port and data dir `down` ticks later.  Peers' sender threads must
+    reconnect through their backoff loop, the rebound listener must
+    accept them, and the restarted node must catch up on everything
+    committed while it was away.  Same reproducibility posture as
+    TcpClusterChaosRunner: the schedule is deterministic from the
+    seed, the invariants (election safety, commit monotonicity, log
+    matching of published streams) must hold on every run, but
+    kernel-scheduled arrival keeps the history non-bit-reproducible.
+    """
+
+    def __init__(self, plan: TcpRebindPlan, tmpdir: str, peers: int = 3):
+        self.plan = plan
+        self.tmpdir = tmpdir
+        self.P = peers
+        self.cfg = RaftConfig(
+            num_groups=2, num_peers=peers, log_window=64,
+            max_entries_per_msg=4, election_ticks=10, heartbeat_ticks=1,
+            tick_interval_s=0.0)
+        self.nodes: List[Optional[RaftNode]] = [None] * peers
+        self.safety = ElectionSafety(LEADER)
+        self.monotonic = CommitMonotonic(peers, self.cfg.num_groups)
+        self._hist: Dict[Tuple[int, int], str] = {}
+        self._urls: List[str] = []
+        self.report = {"commits": 0, "stops": 0, "rebinds": 0}
+
+    def _boot(self, p: int) -> RaftNode:
+        tr = TcpTransport(self._urls, p)
+        n = RaftNode(p + 1, self.P, self.cfg, tr,
+                     os.path.join(self.tmpdir, f"rebind-node-{p + 1}"))
+        n.start(threaded=False)
+        return n
+
+    def _resolve(self, peer: int) -> int:
+        if peer != LEADER_TARGET:
+            return peer
+        for n in self.nodes:
+            if n is not None and n.leader_of(0) >= 0:
+                return int(n.leader_of(0))
+        return 0
+
+    def _drain_live(self) -> None:
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            while True:
+                try:
+                    item = n.commit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None or item is CLOSED:
+                    continue
+                for (g, idx, sql) in _expand_commit_item(item, n):
+                    prev = self._hist.setdefault((g, idx), sql)
+                    if prev != sql:
+                        raise InvariantViolation(
+                            f"log matching: node {p} committed g{g} "
+                            f"i{idx} {sql!r} but {prev!r} was committed")
+                    self.report["commits"] += 1
+
+    def _observe(self, t: int) -> None:
+        G = self.cfg.num_groups
+        roles = np.full((self.P, G), DEAD_ROLE, np.int64)
+        terms = np.zeros((self.P, G), np.int64)
+        commits = np.zeros((self.P, G), np.int64)
+        for p, n in enumerate(self.nodes):
+            if n is None:
+                continue
+            roles[p] = n._last_role
+            terms[p] = n._hard_np[:, 0]
+            commits[p] = n._hard_np[:, 2]
+        self.safety.observe(t, roles, terms)
+        commits = np.maximum(commits, self.monotonic._hi * (roles < 0))
+        self.monotonic.observe(t, commits)
+
+    def run(self) -> dict:
+        ports = _free_ports(self.P)
+        self._urls = [f"127.0.0.1:{port}" for port in ports]
+        rng = np.random.default_rng(self.plan.seed + 1)
+        restart_at: Dict[int, list] = {}
+        for c in self.plan.restarts:
+            restart_at.setdefault(c.tick, []).append(c)
+        down_until: Dict[int, int] = {}
+        total = self.plan.ticks + self.plan.heal_ticks
+        try:
+            for p in range(self.P):
+                self.nodes[p] = self._boot(p)
+            for t in range(total):
+                healing = t >= self.plan.ticks
+                for c in restart_at.get(t, ()):
+                    p = self._resolve(c.peer)
+                    if self.nodes[p] is None:
+                        continue
+                    # Graceful stop: the listener closes and the PORT
+                    # IS RELEASED (crash-without-rebind is the node
+                    # runner's family; this one is about the rebind).
+                    self.nodes[p].stop()
+                    self.nodes[p] = None
+                    down_until[p] = t + c.down
+                    self.report["stops"] += 1
+                for p in [p for p, d in down_until.items() if d <= t]:
+                    del down_until[p]
+                    # Same port, same data dir: replay-from-WAL, then
+                    # peers reconnect into the rebound listener.
+                    self.nodes[p] = self._boot(p)
+                    self.report["rebinds"] += 1
+                if not healing and rng.random() < self.plan.prop_rate:
+                    alive = [p for p, n in enumerate(self.nodes)
+                             if n is not None]
+                    src = alive[int(rng.integers(0, len(alive)))]
+                    g = int(rng.integers(0, self.cfg.num_groups))
+                    self.nodes[src].propose(g, f"SET k{g} v{t}".encode())
+                for n in self.nodes:
+                    if n is not None:
+                        n.tick()
+                time.sleep(0.002)
+                self._drain_live()
+                self._observe(t)
+            # Catch-up check: every node is back, and no node's commit
+            # trails the cluster max by more than one append batch
+            # (the last heartbeat's commit broadcast may be in flight).
+            commits = np.stack([n._hard_np[:, 2] for n in self.nodes])
+            spread = commits.max(axis=0) - commits.min(axis=0)
+            if (spread > self.cfg.max_entries_per_msg).any():
+                raise InvariantViolation(
+                    f"post-heal catch-up failed: commit spread "
+                    f"{spread.tolist()} across rebound nodes")
+        finally:
+            for n in self.nodes:
+                if n is not None:
+                    n.stop()
         return {"plan_digest": self.plan.digest(), **self.report}

@@ -240,6 +240,74 @@ class TcpChaosPlan:
         return hashlib.sha256(blob).hexdigest()[:16]
 
 
+@dataclasses.dataclass(frozen=True)
+class MemberEvent:
+    """Admin membership op issued at `tick` against every group's
+    leader (retried each tick until the leader accepts it):
+    op in {add_learner, promote, remove, remove_learner}."""
+    tick: int
+    op: str
+    peer: int
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeBoot:
+    """Boot peer slot `peer` (fresh, empty WAL — "a new machine") at
+    `tick`; before that the slot is provisioned capacity, down."""
+    tick: int
+    peer: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipChaosPlan:
+    """Scripted membership churn for the lockstep RaftNode cluster:
+    node replacement under faults.  `initial_voters` seeds the boot
+    config over `peers` provisioned slots; `initial_down` slots start
+    unbooted (spare machines)."""
+    seed: int
+    ticks: int
+    peers: int
+    initial_voters: Tuple[int, ...]
+    initial_down: Tuple[int, ...] = ()
+    boots: Tuple[NodeBoot, ...] = ()
+    events: Tuple[MemberEvent, ...] = ()
+    crashes: Tuple[NodeCrash, ...] = ()
+    partitions: Tuple[PartitionWindow, ...] = ()
+    drops: Tuple[DropWindow, ...] = ()
+    # Base-runner parity (NodeClusterChaosRunner drives this plan too):
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    skews: Tuple[SkewWindow, ...] = ()
+    corruptions: Tuple[CorruptWindow, ...] = ()
+    heal_ticks: int = 60
+    prop_rate: float = 0.5
+    # Expected stable config after the script (checked post-heal).
+    final_voters: Tuple[int, ...] = ()
+
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TcpRebindPlan:
+    """TCP-plane crash/restart with PORT REBINDING: stop a node (its
+    listener closes), restart it `down` ticks later on the SAME port
+    and data dir — peers' senders must reconnect and the restarted
+    node must catch up.  Same reproducibility posture as TcpChaosPlan
+    (deterministic schedule, kernel-scheduled arrivals)."""
+    seed: int
+    ticks: int
+    restarts: Tuple[NodeCrash, ...] = ()
+    heal_ticks: int = 80
+    prop_rate: float = 0.6
+
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
 def generate(seed: int, ticks: int = 240, peers: int = 3,
              min_partitions: int = 2, min_crashes: int = 2,
              min_fsync_faults: int = 1,
@@ -479,6 +547,61 @@ def generate_tcp_plan(seed: int, ticks: int = 200,
     return TcpChaosPlan(seed=seed, ticks=ticks, drops=drops,
                         corruptions=corr, asym_partitions=asym,
                         delays=delays)
+
+
+def generate_membership_plan(seed: int, ticks: int = 320,
+                             peers: int = 4) -> MembershipChaosPlan:
+    """The node-replacement story under faults, seeded: a 3-voter
+    cluster over `peers` provisioned slots loses a voter to a
+    PERMANENT kill (SIGKILL, never restarted), boots the spare slot as
+    a fresh machine, adds it as a learner, promotes it once caught up
+    (joint consensus), and removes the dead member — while a drop
+    window and a second (transient) crash land mid-churn.  After the
+    heal window the cluster must run on the replacement voter set with
+    every invariant intact, including RemovedQuorumSafety."""
+    rng = np.random.default_rng(seed ^ 0x3E3)
+    spare = peers - 1
+    dead = int(rng.integers(0, 3))           # the voter that dies
+    kill_t = int(rng.integers(50, 70))
+    boot_t = kill_t + int(rng.integers(5, 15))
+    add_t = boot_t + int(rng.integers(5, 10))
+    promote_t = add_t + int(rng.integers(30, 50))
+    remove_t = promote_t + int(rng.integers(30, 50))
+    # A transient crash of a SURVIVING voter while the learner catches
+    # up, and a drop window across the promote.
+    surv = [p for p in range(3) if p != dead]
+    c1 = int(rng.integers(add_t + 5, promote_t))
+    crashes = (NodeCrash(kill_t, dead, down=10 * ticks),   # permanent
+               NodeCrash(c1, surv[int(rng.integers(0, 2))],
+                         down=int(rng.integers(15, 25))))
+    d0 = promote_t - int(rng.integers(5, 15))
+    drops = (DropWindow(d0, d0 + int(rng.integers(15, 30)),
+                        float(rng.uniform(0.05, 0.15))),)
+    final = tuple(sorted(surv + [spare]))
+    return MembershipChaosPlan(
+        seed=seed, ticks=max(ticks, remove_t + 60), peers=peers,
+        initial_voters=(0, 1, 2), initial_down=(spare,),
+        boots=(NodeBoot(boot_t, spare),),
+        events=(MemberEvent(add_t, "add_learner", spare),
+                MemberEvent(promote_t, "promote", spare),
+                MemberEvent(remove_t, "remove", dead)),
+        crashes=crashes, drops=drops, heal_ticks=80,
+        final_voters=final)
+
+
+def generate_tcp_rebind_plan(seed: int, ticks: int = 180,
+                             peers: int = 3) -> TcpRebindPlan:
+    """TCP crash/restart with port rebinding (ROADMAP chaos frontier):
+    one leader-targeted and one random-follower stop/rebind, spaced so
+    the second fires after the first recovered."""
+    rng = np.random.default_rng(seed ^ 0x4EB)
+    t0 = int(rng.integers(50, 70))
+    d0 = int(rng.integers(20, 30))
+    t1 = int(rng.integers(t0 + d0 + 20, ticks - 30))
+    restarts = (NodeCrash(t0, LEADER_TARGET, down=d0),
+                NodeCrash(t1, int(rng.integers(0, peers)),
+                          down=int(rng.integers(15, 25))))
+    return TcpRebindPlan(seed=seed, ticks=ticks, restarts=restarts)
 
 
 def generate_node_plan(seed: int, ticks: int = 320,

@@ -72,6 +72,17 @@ class RaftConfig:
     log_window: int = 256        # W: on-device log-metadata ring capacity
     max_entries_per_msg: int = 8  # E: entries per AppendEntries batch
 
+    # Initial voter set (dynamic membership, raftsql_tpu/membership/).
+    # None = every peer slot is a voter (the static-cluster default —
+    # quorum math then reproduces the fixed-quorum kernels bit for bit).
+    # A tuple of 0-based slot ids seeds a smaller voter set: the
+    # remaining slots boot as spare/learner capacity that still receives
+    # AppendEntries but is masked out of every quorum until a committed
+    # conf-change entry promotes it.  P is the provisioned slot CAPACITY
+    # (a static device shape); membership changes move voter bits
+    # between slots, they never resize P.
+    initial_voters: "tuple | None" = None
+
     # Timing, in ticks (one device step == one tick).
     election_ticks: int = 10     # min randomized election timeout
     heartbeat_ticks: int = 1     # leader heartbeat period
@@ -143,6 +154,14 @@ class RaftConfig:
             raise ValueError("election_ticks must be > 2*heartbeat_ticks")
         if self.commit_rule not in ("point", "windowed", "pallas"):
             raise ValueError(f"unknown commit_rule {self.commit_rule!r}")
+        if self.initial_voters is not None:
+            vs = tuple(self.initial_voters)
+            if not vs:
+                raise ValueError("initial_voters must name >= 1 voter")
+            if any(not 0 <= v < self.num_peers for v in vs):
+                raise ValueError("initial_voters out of peer-slot range")
+            if len(set(vs)) != len(vs):
+                raise ValueError("initial_voters has duplicates")
         if not self.keep_ring and self.commit_rule != "point":
             raise ValueError(
                 f"commit_rule {self.commit_rule!r} scans the term ring; "

@@ -14,6 +14,7 @@ bytes.
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -66,6 +67,19 @@ class PeerState(NamedTuple):
     # Leader view of each peer (raft Figure 2 volatile leader state).
     match: jax.Array         # [G, P] i32 highest index known replicated on peer
     next_idx: jax.Array      # [G, P] i32 next index to send to peer
+
+    # Active membership configuration as DEVICE data (raftsql_tpu/
+    # membership/): which of the P peer slots are voters, per group.
+    # `voters_joint` is the OLD voter set while a joint C_old,new config
+    # change is in flight (commit/election need a majority of BOTH
+    # masks); in the stable state it equals `voters`, degenerating the
+    # double-majority to the single one.  Slots outside both masks are
+    # learners/spares: they receive AppendEntries and InstallSnapshot
+    # but contribute nothing to any quorum and never campaign.  The
+    # step only READS these; the host patches them (set_group_config)
+    # when a committed conf-change entry applies.
+    voters: jax.Array        # [G, P] bool
+    voters_joint: jax.Array  # [G, P] bool
 
     rng: jax.Array           # [2]/key PRNG state for election jitter
     tick: jax.Array          # [] i32 step counter (for PRNG folding)
@@ -163,6 +177,11 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
     key, sub = jax.random.split(key)
     timeout = jax.random.randint(
         sub, (g,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    voters = jnp.broadcast_to(
+        jnp.asarray(initial_voter_row(cfg))[None, :], (g, p))
+    # Distinct buffer, not an alias: the two masks are donated together
+    # by the jitted step, and a shared buffer trips double-donation.
+    voters_joint = jnp.array(voters)
     return PeerState(
         term=jnp.zeros((g,), I32),
         voted_for=jnp.full((g,), NO_VOTE, I32),
@@ -179,8 +198,56 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
         votes=jnp.zeros((g, p), B),
         match=jnp.zeros((g, p), I32),
         next_idx=jnp.ones((g, p), I32),
+        voters=voters,
+        voters_joint=voters_joint,
         rng=key,
         tick=jnp.zeros((), I32),
+    )
+
+
+def initial_voter_row(cfg: RaftConfig):
+    """[P] bool numpy row of cfg's boot-time voter set (all True when
+    cfg.initial_voters is None — the static-cluster default)."""
+    import numpy as np
+
+    p = cfg.num_peers
+    if cfg.initial_voters is None:
+        return np.ones((p,), bool)
+    row = np.zeros((p,), bool)
+    row[list(cfg.initial_voters)] = True
+    return row
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def set_group_config(state: PeerState, g: jax.Array,
+                     voters_row: jax.Array, joint_row: jax.Array,
+                     self_is_voter: jax.Array) -> PeerState:
+    """Patch group `g`'s active configuration into the device masks.
+
+    Called by the host membership plane when a conf-change log entry
+    APPLIES at commit (two-phase joint style: C_old,new sets voters=new
+    + voters_joint=old; C_new sets both to new).  `self_is_voter` is
+    whether THIS peer remains a voter under the new config: a leader
+    removed by the change steps down to follower on apply (raft §6 —
+    it led long enough to commit its own removal), and a demoted slot
+    can never campaign again (core/step.py gates election timeouts on
+    the mask)."""
+    g = jnp.asarray(g, I32)
+    vrow = jnp.asarray(voters_row, B)
+    jrow = jnp.asarray(joint_row, B)
+    # A non-voter must not be (or stay) leader/candidate: drop to
+    # follower and clear its tally.  It keeps replicating as a learner;
+    # the next append teaches it the new leader.
+    demote = ~jnp.asarray(self_is_voter, B) & (state.role[g] != FOLLOWER)
+    return state._replace(
+        voters=state.voters.at[g].set(vrow),
+        voters_joint=state.voters_joint.at[g].set(jrow),
+        role=state.role.at[g].set(
+            jnp.where(demote, FOLLOWER, state.role[g])),
+        leader_hint=state.leader_hint.at[g].set(
+            jnp.where(demote, NO_LEADER, state.leader_hint[g])),
+        votes=state.votes.at[g].set(
+            jnp.where(demote, False, state.votes[g])),
     )
 
 
@@ -253,7 +320,31 @@ def restore_peer_state(cfg: RaftConfig, self_id: int,
         tbl_pos=jnp.asarray(tbl_pos), tbl_term=jnp.asarray(tbl_term))
 
 
-import functools
+@functools.partial(jax.jit, donate_argnums=0)
+def set_group_config_stacked(states: PeerState, p: jax.Array,
+                             g: jax.Array, voters_row: jax.Array,
+                             joint_row: jax.Array,
+                             self_is_voter: jax.Array) -> PeerState:
+    """`set_group_config` over a STACKED cluster state (leaves
+    [P, G, ...], runtime/fused.py): patch peer row `p`'s view of group
+    `g`.  Each peer row applies a conf entry when ITS OWN commit passes
+    the entry — exactly the distributed runtime's timing, co-located."""
+    p = jnp.asarray(p, I32)
+    g = jnp.asarray(g, I32)
+    vrow = jnp.asarray(voters_row, B)
+    jrow = jnp.asarray(joint_row, B)
+    demote = ~jnp.asarray(self_is_voter, B) \
+        & (states.role[p, g] != FOLLOWER)
+    return states._replace(
+        voters=states.voters.at[p, g].set(vrow),
+        voters_joint=states.voters_joint.at[p, g].set(jrow),
+        role=states.role.at[p, g].set(
+            jnp.where(demote, FOLLOWER, states.role[p, g])),
+        leader_hint=states.leader_hint.at[p, g].set(
+            jnp.where(demote, NO_LEADER, states.leader_hint[p, g])),
+        votes=states.votes.at[p, g].set(
+            jnp.where(demote, False, states.votes[p, g])),
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=0)

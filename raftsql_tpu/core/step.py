@@ -40,7 +40,8 @@ from raftsql_tpu.config import (CANDIDATE, FLOOR_HINT_BIAS, FOLLOWER, LEADER,
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
-from raftsql_tpu.ops.quorum import quorum_commit_index, vote_count
+from raftsql_tpu.ops.quorum import masked_quorum_commit_index, \
+    masked_vote_win
 
 
 def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
@@ -87,9 +88,20 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     """
     G, P, W, E = cfg.num_groups, cfg.num_peers, cfg.log_window, \
         cfg.max_entries_per_msg
-    quorum = cfg.quorum
     src_ids = jnp.arange(P, dtype=I32)[None, :]                  # [1, P]
     self_onehot = src_ids == self_id                             # [1, P]
+
+    # Active membership configuration (device data, raftsql_tpu/
+    # membership/): every quorum below — commit advance, election
+    # tally, prevote tally, vote granting — reads these masks, so N
+    # groups can sit in N different configurations inside this one
+    # program.  The static all-voters default reproduces the old fixed
+    # cfg.quorum math bit for bit.  `voter_src[g, p]` = slot p is a
+    # voter of group g under EITHER mask (joint consensus counts both);
+    # `self_voter[g]` = this peer may campaign.
+    voters, jvoters = state.voters, state.voters_joint
+    voter_src = voters | jvoters                                 # [G, P]
+    self_voter = jnp.sum(voter_src & self_onehot, axis=-1) > 0   # [G]
 
     log_term, log_len = state.log_term, state.log_len
     tbl_pos, tbl_term = state.tbl_pos, state.tbl_term
@@ -133,7 +145,11 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     up2date = (inbox.v_last_term > my_last_term[:, None]) | (
         (inbox.v_last_term == my_last_term[:, None])
         & (inbox.v_last_idx >= log_len[:, None]))
-    eligible = vreq_cur & up2date & (
+    # voter_src gate: never grant to a candidate WE believe is outside
+    # the active configuration — once a removal commits at a majority,
+    # the removed peer can no longer assemble a quorum of grants ("no
+    # quorum from a removed majority", chaos/invariants.py).
+    eligible = vreq_cur & up2date & voter_src & (
         (voted == NO_VOTE)[:, None] | (voted[:, None] == src_ids))
     any_grant = eligible.any(-1)
     grant_to = jnp.argmax(eligible, axis=-1).astype(I32)          # [G]
@@ -151,7 +167,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         in_lease = (leader_hint != NO_LEADER) & \
             (state.elapsed < cfg.election_ticks)
         pre_grant = preq & (inbox.v_term > term[:, None]) & up2date \
-            & ~in_lease[:, None]
+            & voter_src & ~in_lease[:, None]
     else:
         pre_grant = jnp.zeros_like(preq)
 
@@ -171,7 +187,8 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
             & (inbox.v_term == term[:, None] + 1) \
             & (role == PRECANDIDATE)[:, None]
         votes = votes | got_pre
-        become_cand = (role == PRECANDIDATE) & (vote_count(votes) >= quorum)
+        become_cand = (role == PRECANDIDATE) \
+            & masked_vote_win(votes, voters, jvoters)
         term = jnp.where(become_cand, term + 1, term)
         role = jnp.where(become_cand, CANDIDATE, role)
         voted = jnp.where(become_cand, self_id, voted)
@@ -181,7 +198,8 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     got_vote = (inbox.v_type == MSG_RESP) & (inbox.v_term == term[:, None]) \
         & inbox.v_granted & (role == CANDIDATE)[:, None]
     votes = votes | got_vote
-    become_leader = (role == CANDIDATE) & (vote_count(votes) >= quorum)
+    become_leader = (role == CANDIDATE) \
+        & masked_vote_win(votes, voters, jvoters)
     role = jnp.where(become_leader, LEADER, role)
     leader_hint = jnp.where(become_leader, self_id, leader_hint)
     next_idx = jnp.where(become_leader[:, None], log_len[:, None] + 1,
@@ -383,24 +401,30 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # (selected by cfg.commit_rule; all implement raft Fig. 2's leader
     # rule, see ops/commit_scan.py and ops/pallas_quorum.py).
     if cfg.commit_rule == "windowed":
-        from raftsql_tpu.ops.commit_scan import windowed_commit_index
-        commit = windowed_commit_index(
+        from raftsql_tpu.ops.commit_scan import \
+            masked_windowed_commit_index
+        commit = masked_windowed_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            quorum=quorum, window=W)
+            voters=voters, voters_joint=jvoters, window=W)
     elif cfg.commit_rule == "pallas":
-        from raftsql_tpu.ops.pallas_quorum import pallas_quorum_commit_index
-        commit = pallas_quorum_commit_index(
+        from raftsql_tpu.ops.pallas_quorum import \
+            pallas_masked_quorum_commit_index
+        commit = pallas_masked_quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            quorum=quorum, window=W)
+            voters=voters, voters_joint=jvoters, window=W)
     else:
-        commit = quorum_commit_index(
+        commit = masked_quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            quorum=quorum, window=W, term_of=term_of1)
+            voters=voters, voters_joint=jvoters, window=W,
+            term_of=term_of1)
 
     # ---- Phase 8: timers and election start.
     reset = any_grant | any_app
     elapsed = jnp.where(is_leader | reset, 0, state.elapsed + timer_inc)
-    fire = (role != LEADER) & (elapsed >= state.timeout)
+    # Learners/spares (self outside both masks) never campaign: their
+    # timers tick but cannot fire — they follow whoever the voters
+    # elect and wait for a conf entry to promote them.
+    fire = (role != LEADER) & (elapsed >= state.timeout) & self_voter
     term_resp = term          # term used in responses composed above
     if cfg.prevote:
         # Timeout starts a PROBE, not an election: role flips to
@@ -577,6 +601,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         tbl_pos=tbl_pos, tbl_term=tbl_term,
         elapsed=elapsed, timeout=timeout, hb_elapsed=hb,
         votes=votes, match=match, next_idx=next_idx,
+        voters=voters, voters_joint=jvoters,
         rng=state.rng, tick=state.tick + 1)
 
     # Ticks until any timer could fire with no further input: non-leader

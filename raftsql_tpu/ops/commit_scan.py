@@ -38,11 +38,37 @@ def windowed_commit_index(match: jax.Array, log_term: jax.Array,
       commit < n <= quorum_match  and  term_of(n) == current term.
     The advance is the max committable n, or `commit` unchanged.
     """
-    _, W = log_term.shape
     P = match.shape[-1]
     sorted_match = jnp.sort(match, axis=-1)
     qmatch = sorted_match[..., P - quorum]                        # [G]
+    return _windowed_from_qmatch(qmatch, log_term, log_len, commit,
+                                 term, is_leader)
 
+
+def masked_windowed_commit_index(match: jax.Array, log_term: jax.Array,
+                                 log_len: jax.Array, commit: jax.Array,
+                                 term: jax.Array, is_leader: jax.Array,
+                                 *, voters: jax.Array,
+                                 voters_joint: jax.Array,
+                                 window: int) -> jax.Array:
+    """The windowed rule under a per-group voter configuration
+    (ops/quorum.py mask-weighted quorum): the scan's ceiling is the min
+    of the two masks' quorum indexes (joint consensus), so every group
+    can sit in a different configuration inside the one fused kernel.
+    Full masks reproduce `windowed_commit_index` bit for bit."""
+    from raftsql_tpu.ops.quorum import masked_quorum_match_index
+
+    qmatch = jnp.minimum(masked_quorum_match_index(match, voters),
+                         masked_quorum_match_index(match, voters_joint))
+    return _windowed_from_qmatch(qmatch, log_term, log_len, commit,
+                                 term, is_leader)
+
+
+def _windowed_from_qmatch(qmatch: jax.Array, log_term: jax.Array,
+                          log_len: jax.Array, commit: jax.Array,
+                          term: jax.Array,
+                          is_leader: jax.Array) -> jax.Array:
+    _, W = log_term.shape
     slot = jnp.arange(W, dtype=I32)[None, :]                      # [1, W]
     # Log index currently resident in each ring slot: the unique
     # n in (log_len - W, log_len] with (n-1) % W == slot.

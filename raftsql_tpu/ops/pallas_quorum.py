@@ -101,3 +101,89 @@ def pallas_quorum_commit_index(match: jax.Array, log_term: jax.Array,
         interpret=interpret,
     )(*args)
     return out[:G, 0]
+
+
+# ---------------------------------------------------------------------------
+# Mask-weighted variant (dynamic membership, raftsql_tpu/membership/):
+# the static quorum constant becomes per-group [G, P] voter masks (plus
+# the second joint-consensus mask), still one comparison network — the
+# count just multiplies by the mask and the threshold is a per-row
+# popcount majority.  With full masks this reproduces the static kernel
+# exactly (tests/test_membership.py property-tests both paths).
+
+_NEG = -(1 << 30)
+
+
+def _masked_kernel(window: int,
+                   match_ref, vot_ref, jvot_ref, log_term_ref,
+                   log_len_ref, commit_ref, term_ref, leader_ref,
+                   out_ref):
+    match = match_ref[:]                      # [Gb, P]
+    vot = vot_ref[:] != 0                     # [Gb, P]
+    jvot = jvot_ref[:] != 0                   # [Gb, P]
+    ring = log_term_ref[:]                    # [Gb, W]
+    log_len = log_len_ref[:]                  # [Gb, 1]
+    commit = commit_ref[:]                    # [Gb, 1]
+    term = term_ref[:]                        # [Gb, 1]
+    is_leader = leader_ref[:] != 0            # [Gb, 1]
+    P = match.shape[-1]
+
+    def qidx(mask):
+        m = jnp.where(mask, match, _NEG)
+        mi32 = mask.astype(I32)
+        nv = jnp.sum(mi32, axis=-1, keepdims=True)      # [Gb, 1]
+        need = nv // 2 + 1
+        cand = jnp.full_like(commit, _NEG)
+        for i in range(P):
+            mi = m[:, i:i + 1]
+            cnt = jnp.sum((m >= mi).astype(I32) * mi32, axis=-1,
+                          keepdims=True)
+            ok = mask[:, i:i + 1] & (cnt >= need) & (mi > cand)
+            cand = jnp.where(ok, mi, cand)
+        # Empty mask (all-learner group): no quorum index exists.
+        return jnp.where(nv > 0, jnp.maximum(cand, 0), 0)
+
+    # Joint consensus: the candidate must hold on BOTH masks.
+    cand = jnp.minimum(qidx(vot), qidx(jvot))
+
+    slot = (cand - 1) % window                # [Gb, 1]
+    lanes = jax.lax.broadcasted_iota(I32, ring.shape, 1)
+    cand_term = jnp.sum(jnp.where(lanes == slot, ring, 0), axis=-1,
+                        keepdims=True)
+    valid = (cand >= 1) & (cand <= log_len)
+    cand_term = jnp.where(valid, cand_term, 0)
+
+    ok = is_leader & (cand_term == term) & (cand > commit)
+    out_ref[:] = jnp.where(ok, cand, commit)
+
+
+def pallas_masked_quorum_commit_index(
+        match: jax.Array, log_term: jax.Array, log_len: jax.Array,
+        commit: jax.Array, term: jax.Array, is_leader: jax.Array,
+        *, voters: jax.Array, voters_joint: jax.Array, window: int,
+        block_g: int = 1024,
+        interpret: bool | None = None) -> jax.Array:
+    """Mask-weighted drop-in for `ops.quorum.masked_quorum_commit_index`."""
+    G, P = match.shape
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    gb = min(block_g, G)
+    pad = (-G) % gb
+    col = lambda x: x.astype(I32).reshape(G, 1)
+    args = (match.astype(I32), voters.astype(I32),
+            voters_joint.astype(I32), log_term.astype(I32),
+            col(log_len), col(commit), col(term), col(is_leader))
+    if pad:
+        args = tuple(jnp.pad(x, ((0, pad), (0, 0))) for x in args)
+    gp = G + pad
+
+    widths = (P, P, P, window, 1, 1, 1, 1)
+    out = pl.pallas_call(
+        functools.partial(_masked_kernel, window),
+        grid=(gp // gb,),
+        in_specs=[pl.BlockSpec((gb, w), lambda i: (i, 0)) for w in widths],
+        out_specs=pl.BlockSpec((gb, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((gp, 1), I32),
+        interpret=interpret,
+    )(*args)
+    return out[:G, 0]

@@ -13,11 +13,26 @@ The q-th largest of P match indexes is a sort + static gather; XLA lowers
 the tiny fixed-width sort over the peers axis to a comparator network, which
 fuses cleanly into the surrounding step.  See `ops.pallas_quorum` for the
 hand-written Pallas variant used when P is large.
+
+Dynamic membership (raftsql_tpu/membership/) generalizes the static
+"q-th largest of P" to MASK-WEIGHTED quorum: each group carries a
+[G, P] voter bitmask (plus a second mask while a joint C_old,new config
+is in flight), non-voters contribute -inf to the sort, and the quorum
+threshold is a per-group popcount majority — so N groups can sit in N
+different configurations inside one fused dispatch.  With a full voter
+mask the masked kernels reproduce the static ones bit for bit
+(property-tested in tests/test_membership.py).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+I32 = jnp.int32
+# Non-voter filler for the masked sort: far below any real match index
+# (log positions are small non-negative ints) so a non-voter can never
+# be selected as a quorum index.
+NON_VOTER = -(1 << 30)
 
 
 def quorum_match_index(match: jax.Array, quorum: int) -> jax.Array:
@@ -25,6 +40,76 @@ def quorum_match_index(match: jax.Array, quorum: int) -> jax.Array:
     P = match.shape[-1]
     sorted_match = jnp.sort(match, axis=-1)          # ascending
     return sorted_match[..., P - quorum]
+
+
+def mask_majority(mask: jax.Array) -> jax.Array:
+    """[..., P] bool voter mask -> [...] i32 majority threshold.
+
+    floor(popcount/2) + 1.  An EMPTY mask (all-learner group) yields 1,
+    which a masked tally of 0 can never reach — such a group never
+    elects and never commits, by construction rather than special case.
+    """
+    return mask.sum(-1).astype(I32) // 2 + 1
+
+
+def masked_vote_count(votes: jax.Array, mask: jax.Array) -> jax.Array:
+    """[G, P] bool votes -> [G] granted votes FROM VOTERS only."""
+    return jnp.sum(votes & mask, axis=-1).astype(I32)
+
+
+def masked_vote_win(votes: jax.Array, voters: jax.Array,
+                    voters_joint: jax.Array) -> jax.Array:
+    """[G] bool: the vote set wins under the active configuration.
+
+    Joint consensus (raft §6 / the thesis' C_old,new): a candidate needs
+    a majority of BOTH masks.  In the stable state voters_joint ==
+    voters and the double check degenerates to the single majority.
+    """
+    return (masked_vote_count(votes, voters) >= mask_majority(voters)) \
+        & (masked_vote_count(votes, voters_joint)
+           >= mask_majority(voters_joint))
+
+
+def masked_quorum_match_index(match: jax.Array,
+                              voters: jax.Array) -> jax.Array:
+    """[G, P] match + [G, P] bool voter mask -> [G] mask-weighted
+    quorum index: the largest index replicated on a majority of the
+    group's voters.  Non-voters contribute NON_VOTER to the sort; the
+    per-group majority selects a (data-dependent) sorted position via a
+    one-hot reduce — no gather.  With a full mask this is exactly
+    `quorum_match_index(match, P // 2 + 1)`."""
+    P = match.shape[-1]
+    m = jnp.where(voters, match, NON_VOTER)
+    s = jnp.sort(m, axis=-1)                         # ascending
+    need = mask_majority(voters)                     # [G]
+    lanes = jnp.arange(P, dtype=I32)
+    sel = lanes == (P - need)[..., None]             # [G, P] one-hot
+    got = jnp.sum(jnp.where(sel, s, 0), axis=-1)
+    # All-learner group: no voter can supply a quorum index at all.
+    return jnp.where(voters.any(-1), got, 0)
+
+
+def masked_quorum_commit_index(match: jax.Array, log_term: jax.Array,
+                               log_len: jax.Array, commit: jax.Array,
+                               term: jax.Array, is_leader: jax.Array,
+                               *, voters: jax.Array,
+                               voters_joint: jax.Array, window: int,
+                               term_of=None) -> jax.Array:
+    """`quorum_commit_index` under the active per-group configuration:
+    the commit candidate must be replicated on a majority of BOTH masks
+    (joint consensus), i.e. the min of the two mask-weighted quorum
+    indexes.  Stable groups (joint == voters) reduce to the single-mask
+    rule, and a full mask reproduces the static kernel bit for bit."""
+    from raftsql_tpu.core.state import term_at
+
+    cand = jnp.minimum(masked_quorum_match_index(match, voters),
+                       masked_quorum_match_index(match, voters_joint))
+    if term_of is None:
+        cand_term = term_at(log_term, log_len, cand, window)
+    else:
+        cand_term = term_of(cand)
+    ok = is_leader & (cand_term == term) & (cand > commit)
+    return jnp.where(ok, cand, commit)
 
 
 def quorum_commit_index(match: jax.Array, log_term: jax.Array,

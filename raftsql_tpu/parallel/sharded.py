@@ -41,6 +41,16 @@ PEERS_AXIS = "peers"
 GROUPS_AXIS = "groups"
 
 
+class MeshLockstepOnlyError(NotImplementedError):
+    """The mesh (shard_map) runtime ticks every peer in LOCKSTEP: the
+    sharded step has no per-peer timer_inc plumbing, so per-peer clock
+    skew (chaos SkewWindow schedules, or any per-peer pacing) cannot be
+    expressed on it.  Run skew scenarios on the single-chip fused
+    runtime (runtime/fused.py FusedClusterNode, whose cluster_step takes
+    a [P] timer_inc), or extend make_sharded_step_fn to shard a [P]
+    timer vector alongside prop_n."""
+
+
 def make_mesh(n_peer_shards: int, n_group_shards: int,
               devices=None) -> Mesh:
     """Build the ('peers', 'groups') mesh over the first pp*gg devices."""
@@ -74,6 +84,7 @@ def state_specs() -> PeerState:
         tbl_pos=s3, tbl_term=s3,
         elapsed=s2, timeout=s2, hb_elapsed=s2,
         votes=s3, match=s3, next_idx=s3,
+        voters=s3, voters_joint=s3,
         rng=P(PEERS_AXIS), tick=P(PEERS_AXIS))
 
 

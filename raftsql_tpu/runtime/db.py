@@ -37,6 +37,7 @@ from typing import Callable, Dict, Optional, Tuple
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
 from raftsql_tpu.runtime.envelope import unwrap
+from raftsql_tpu.transport.codec import is_conf_entry
 from raftsql_tpu.runtime.node import (CLOSED, RAW_BATCH, RAW_MANY,
                                       RAW_PLAIN)
 from raftsql_tpu.runtime.pipe import RaftPipe
@@ -99,7 +100,7 @@ def _expand_commit_item(item, node=None):
         dedup = node.dedup_for(g) if node is not None else None
         out = []
         for off, data in enumerate(datas):
-            if not data:
+            if not data or is_conf_entry(data):
                 continue                    # no-op/conf entry
             pid, payload = unwrap(data)
             if pid is not None and dedup is not None \
@@ -110,11 +111,13 @@ def _expand_commit_item(item, node=None):
     if item[0] is RAW_PLAIN:
         _, g, base, datas = item
         return [(g, base + 1 + off, data.decode("utf-8"))
-                for off, data in enumerate(datas) if data]
+                for off, data in enumerate(datas)
+                if data and not is_conf_entry(data)]
     if item[0] is RAW_MANY:
         return [(g, base + 1 + off, data.decode("utf-8"))
                 for (g, base, datas) in item[1]
-                for off, data in enumerate(datas) if data]
+                for off, data in enumerate(datas)
+                if data and not is_conf_entry(data)]
     if len(item) == 2:
         g = item[0]
         return [(g, i, s) for (i, s) in item[1]]
@@ -512,10 +515,48 @@ class RaftDB:
         a50, a99 = self.latency.percentiles((0.5, 0.99))
         m["propose_ack_p50_ms"] = ms(a50)
         m["propose_ack_p99_ms"] = ms(a99)
+        # Membership observability (raftsql_tpu/membership/): active
+        # voter/learner slot totals across groups + applied conf-change
+        # count.  Engines without a manager report the static shape.
+        node = self.pipe.node
+        mm = getattr(node, "membership", None)
+        if mm is not None:
+            v, l = mm.counts()
+        else:
+            v, l = node.cfg.num_peers * node.cfg.num_groups, 0
+        m["members_voters"] = v
+        m["members_learners"] = l
         return m
 
     def render_metrics(self) -> str:
         return json.dumps(self.metrics(), sort_keys=True) + "\n"
+
+    # -- membership admin (raftsql_tpu/membership/) ---------------------
+
+    def members(self) -> dict:
+        """GET /members: per-group active configuration + leader."""
+        node = self.pipe.node
+        fn = getattr(node, "members_doc", None)
+        if fn is None:
+            return {"error": "engine has no membership plane"}
+        return fn()
+
+    def member_change(self, group: int, op: str, peer: int) -> dict:
+        """POST /members: propose add/remove/promote of a peer slot.
+        Maps the membership plane's not-leader error onto the API's
+        NotLeaderError so both HTTP planes answer 421 + the hint."""
+        from raftsql_tpu.membership import NotLeaderForChange
+        node = self.pipe.node
+        fn = getattr(node, "member_change", None)
+        if fn is None:
+            raise ValueError("engine has no membership plane")
+        try:
+            return fn(group, op, peer)
+        except NotLeaderForChange as e:
+            raise NotLeaderError(e.group, e.leader) from e
+
+    def render_members(self) -> str:
+        return json.dumps(self.members(), sort_keys=True) + "\n"
 
     # -- observability exports (raftsql_tpu/obs/) ----------------------
 

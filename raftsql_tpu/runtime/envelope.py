@@ -119,3 +119,31 @@ def unwrap_snapshot(blob: bytes):
                      for k in range(n)]
             return pairs, blob[need:]
     return None, blob
+
+
+# Membership-over-snapshot framing (raftsql_tpu/membership/): an
+# InstallSnapshot transfer SKIPS the log, so a receiver restored by one
+# would miss any conf-change entries inside the skipped range and keep
+# a stale voter configuration.  The sender therefore wraps the (already
+# dedup-wrapped) transfer blob with the ACTIVE config at the snapshot
+# point; receivers without the magic byte see a bare blob (framing is
+# optional, like the dedup wrapper above).
+#   0x04 | u64 conf_index | u32 conf_len | conf_entry_bytes | inner
+_CONF_MAGIC = 0x04
+_CONF_HDR = struct.Struct("<BQI")
+
+
+def wrap_snapshot_conf(conf_index: int, conf_entry: bytes,
+                       inner: bytes) -> bytes:
+    return _CONF_HDR.pack(_CONF_MAGIC, conf_index,
+                          len(conf_entry)) + conf_entry + inner
+
+
+def unwrap_snapshot_conf(blob: bytes):
+    """Returns ((conf_index, conf_entry) or None, inner_blob)."""
+    if len(blob) >= _CONF_HDR.size and blob[0] == _CONF_MAGIC:
+        _, idx, n = _CONF_HDR.unpack_from(blob)
+        off = _CONF_HDR.size
+        if len(blob) >= off + n:
+            return (idx, blob[off:off + n]), blob[off + n:]
+    return None, blob

@@ -56,8 +56,12 @@ from raftsql_tpu.core.cluster import (cluster_multistep_host,
                                       cluster_step_host,
                                       empty_cluster_inbox,
                                       init_cluster_state)
-from raftsql_tpu.core.state import restore_peer_state
+from raftsql_tpu.core.state import (restore_peer_state,
+                                    set_group_config_stacked)
 from raftsql_tpu.core.step import INFO_FIELDS
+from raftsql_tpu.transport.codec import (CONF_PREFIX as _CONF_PREFIX,
+                                         decode_conf_entry,
+                                         is_conf_entry)
 from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
 from raftsql_tpu.native.build import load_native_plog
 from raftsql_tpu.storage import fsio
@@ -172,6 +176,15 @@ class FusedClusterNode:
         # attribute test and the step signatures are untouched.
         self.tracer = None
         self.ring = None
+        # Dynamic membership (raftsql_tpu/membership/), opt-in for the
+        # fused plane via enable_membership(): None keeps the static
+        # tick byte-identical (every hook gates on one attribute test).
+        self.membership = None
+        self._conf_pending: List[list] = []      # per group [(idx, data)]
+        self._conf_scrub: List[set] = []         # per group conf indexes
+        self._conf_cursor: Optional[np.ndarray] = None   # [P, G]
+        self._replayed_conf: List[Dict[int, tuple]] = [
+            {} for _ in range(P)]
         self.error: Optional[Exception] = None
         self._work_evt = threading.Event()
         self._stop_evt = threading.Event()
@@ -305,6 +318,8 @@ class FusedClusterNode:
         device state, payload log, and the replayed committed prefix
         published to its commit stream."""
         logs = WAL.replay(d)
+        self._replayed_conf[p] = {g: gl.conf for g, gl in logs.items()
+                                  if gl.conf is not None}
         self.wals.append(WAL(d, segment_bytes=self.cfg.wal_segment_bytes))
         plog = (NativePayloadLog(self.cfg.num_groups, self._plog_lib)
                 if self._plog_lib is not None
@@ -355,6 +370,154 @@ class FusedClusterNode:
                                         depth=ring_depth, keep=keep)
         for w in self.wals:
             w.obs = self.tracer
+
+    # -- dynamic membership (raftsql_tpu/membership/) -------------------
+
+    def enable_membership(self, initial_voters=None) -> None:
+        """Attach the membership plane: per-group voter masks as device
+        state, conf entries applied per PEER ROW as that row's commit
+        passes them, durable REC_CONF baselines per peer WAL.  Restores
+        each peer's active config from its replayed WAL (baseline +
+        retained conf entries).  Call before the tick loop; idempotent."""
+        from raftsql_tpu.membership import MembershipManager
+        if self.membership is not None:
+            return
+        P, G = self.cfg.num_peers, self.cfg.num_groups
+        iv = initial_voters if initial_voters is not None \
+            else self.cfg.initial_voters
+        mm = MembershipManager(P, G, initial_voters=iv)
+        self._conf_pending = [[] for _ in range(G)]
+        self._conf_scrub = [set() for _ in range(G)]
+        self._conf_cursor = np.zeros((P, G), np.int64)
+        pend: List[Dict[int, bytes]] = [dict() for _ in range(G)]
+        for p in range(P):
+            view = MembershipManager(P, G, initial_voters=iv)
+            for g in range(G):
+                base = self._replayed_conf[p].get(g)
+                plog = self.plogs[p]
+                start, ln = plog.start(g), plog.length(g)
+                datas = plog.try_slice(g, start + 1, ln - start) \
+                    if ln > start else []
+                entries = [(0, d) for d in (datas or [])]
+                if view.restore(g, base, entries, start,
+                                int(self._hard[p, g, 2])):
+                    c = view.config(g)
+                    self._patch_conf_row(p, g, c.entry(0))
+                    self._conf_cursor[p, g] = c.index
+                    # The cluster authority adopts the most advanced
+                    # per-group view (full-picture entries make this a
+                    # plain superseding apply).
+                    mm.apply(g, c.index, c.entry(0))
+                for idx, d in view.appended_list(g):
+                    pend[g].setdefault(idx, d)
+        self.membership = mm
+        for g in range(G):
+            for idx in sorted(pend[g]):
+                self._conf_note(g, idx, pend[g][idx])
+
+    def _conf_note(self, g: int, idx: int, data: bytes) -> None:
+        """A conf entry entered some peer's log at `idx` (tick thread)."""
+        lst = self._conf_pending[g]
+        lst[:] = [(i, d) for (i, d) in lst if i != idx]
+        lst.append((idx, data))
+        lst.sort()
+        # New set object (not in-place add): the publisher thread scrubs
+        # from whatever reference it grabbed — no concurrent mutation.
+        self._conf_scrub[g] = self._conf_scrub[g] | {idx}
+
+    def _patch_conf_row(self, p: int, g: int, data: bytes) -> None:
+        got = decode_conf_entry(data)
+        if got is None:
+            return
+        _, v, j, _l = got
+        P = self.cfg.num_peers
+        vrow = np.array([bool(v >> i & 1) for i in range(P)])
+        jrow = np.array([bool(j >> i & 1) for i in range(P)])
+        self.states = set_group_config_stacked(
+            self.states, p, g, vrow, jrow, bool((v | j) >> p & 1))
+
+    def _membership_advance(self, pinfo: np.ndarray) -> None:
+        """Apply pending conf entries to each peer row whose commit
+        passed them, drive the auto LEAVE_JOINT, and keep the cluster
+        authority in sync.  Tick thread, after the durable phases."""
+        mm = self.membership
+        P = self.cfg.num_peers
+        commit = pinfo[:, :, _C["commit"]]
+        for g, lst in enumerate(self._conf_pending):
+            if not lst:
+                continue
+            drop: List[int] = []
+            for (idx, data) in list(lst):
+                all_done = True
+                superseded = False
+                for p in range(P):
+                    if self._conf_cursor[p, g] >= idx:
+                        continue
+                    if commit[p, g] < idx:
+                        all_done = False
+                        continue
+                    got = self.plogs[p].try_slice(g, idx, 1)
+                    if got is None:
+                        continue          # compacted under us: settled
+                    if got[0] != data:
+                        # Conflict truncation rewrote the slot before
+                        # commit: this conf never happened.
+                        superseded = True
+                        break
+                    self._patch_conf_row(p, g, data)
+                    self._conf_cursor[p, g] = idx
+                    # Per-peer durable baseline: THIS entry's masks (the
+                    # cluster authority may already be ahead).
+                    _k, cv, cj, cl = decode_conf_entry(data)
+                    self.wals[p].set_conf(g, idx, _k, cv, cj, cl)
+                    if mm.apply(g, idx, data) is not None:
+                        self.metrics.conf_changes_applied += 1
+                if superseded:
+                    mm.abort_pending(g)      # the change never happened
+                if superseded or all_done:
+                    drop.append(idx)
+            if drop:
+                lst[:] = [(i, d) for (i, d) in lst if i not in drop]
+        # Whichever peer leads a joint group finishes the transition.
+        for g in list(mm.joint_groups):
+            if self._hints[g] >= 0:
+                entry = mm.maybe_leave(g, self._tick_no,
+                                       4 * self.cfg.election_ticks)
+                if entry is not None:
+                    self.propose_many(g, [entry])
+
+    def members_doc(self) -> dict:
+        if self.membership is None:
+            return {"error": "membership plane not enabled "
+                             "(enable_membership())"}
+        out = {}
+        for g in range(self.cfg.num_groups):
+            d = self.membership.describe(g)
+            d["leader"] = self.leader_of(g) + 1
+            out[str(g)] = d
+        return {"num_peers": self.cfg.num_peers, "groups": out,
+                "node": 0}
+
+    def member_change(self, group: int, op: str, peer: int) -> dict:
+        """Admin plane for the co-located cluster: every peer lives in
+        this process, so routing goes through propose_many's leader
+        hint instead of a wire forward."""
+        from raftsql_tpu.membership import MembershipLagError
+        if self.membership is None:
+            raise RuntimeError("membership plane not enabled "
+                               "(enable_membership())")
+        if op == "promote":
+            lead = int(self._hints[group])
+            commit = int(self._hard[max(lead, 0), group, 2])
+            have = self.plogs[peer].length(group)
+            if commit - have > self.cfg.max_entries_per_msg:
+                raise MembershipLagError(
+                    f"group {group}: learner {peer} is "
+                    f"{commit - have} entries behind; retry after "
+                    "catch-up")
+        entry = self.membership.make_change(group, op, peer)
+        self.propose_many(group, [entry])
+        return self.membership.describe(group)
 
     def propose_many(self, group: int, payloads) -> None:
         """Queue payloads at the group's current leader peer (host-side
@@ -683,6 +846,11 @@ class FusedClusterNode:
             self._epoch_no = self._ep_no_this
             self._commit_epoch(self._epoch_no)
         self._ep_active = False
+        if self.membership is not None:
+            # Apply-at-commit for conf entries: patch each peer row
+            # whose commit passed a pending entry, BEFORE this tick's
+            # publish enqueue (the scrub set must cover the batch).
+            self._membership_advance(pinfo)
         t4 = _t.monotonic()
         # Quiescence signal for the threaded loop: anything written,
         # any group leaderless, or any proposal backlog means "keep
@@ -818,6 +986,7 @@ class FusedClusterNode:
             if ags.size:
                 props_p = self._props[p]
                 traced = [] if self.tracer is not None else None
+                confs = [] if self.membership is not None else None
                 with self._prop_lock:   # pops race client-thread extends
                     for g, n, b0, tm in zip(ags.tolist(),
                                             acc[ags].tolist(),
@@ -833,6 +1002,17 @@ class FusedClusterNode:
                         r_term.append(tm)
                         if traced is not None:
                             traced.append((g, b0, batch))
+                        if confs is not None:
+                            # Conf entries entering the cluster log —
+                            # one leading-byte test per accepted
+                            # proposal, only with membership enabled.
+                            for off, d in enumerate(batch):
+                                if d[:1] == _CONF_PREFIX \
+                                        and is_conf_entry(d):
+                                    confs.append((g, b0 + off, d))
+                if confs:
+                    for (cg, cidx, cd) in confs:
+                        self._conf_note(cg, cidx, cd)
                 self.metrics.proposals += int(acc[ags].sum())
                 if traced:
                     # Append stamp + index binding, outside the lock.
@@ -1000,6 +1180,20 @@ class FusedClusterNode:
             list(self._sync_pool.map(lambda w: w.sync(), self.wals))
         return tick_active
 
+    def _scrub_conf(self, g: int, base: int, datas: list) -> list:
+        """Blank conf entries out of a publish batch (entries at
+        base+1..): the apply plane sees an empty slot where the
+        membership change sat.  Index-driven off the scrub set — zero
+        per-entry work; `_conf_scrub[g]` is replaced (never mutated) so
+        the async publisher thread can read it lock-free."""
+        scrub = self._conf_scrub[g]
+        if scrub:
+            top = base + len(datas)
+            for idx in scrub:
+                if base < idx <= top:
+                    datas[idx - base - 1] = b""
+        return datas
+
     def _publish(self, pinfo: np.ndarray) -> None:
         """Deliver a saved tick's newly committed entries to each peer's
         commit stream (they were fsynced before this runs) — the whole
@@ -1027,7 +1221,8 @@ class FusedClusterNode:
             gl = ready.tolist()
             cl = commit[ready].tolist()
             al = self._applied[p][ready].tolist()
-            if p == 0 and self.native_kv is not None:
+            if p == 0 and self.native_kv is not None \
+                    and self.membership is None:
                 # C-resident apply: one call, zero Python per entry.
                 self.native_kv.apply_plog(
                     plog.handle, gl, [a + 1 for a in al],
@@ -1043,6 +1238,8 @@ class FusedClusterNode:
                     gl, [a + 1 for a in al],
                     [c - a for c, a in zip(cl, al)])
                 for g, a, datas in zip(gl, al, per_range):
+                    if self.membership is not None:
+                        datas = self._scrub_conf(g, a, list(datas))
                     if any(datas):
                         items.append((g, a, datas))
             else:
@@ -1053,6 +1250,8 @@ class FusedClusterNode:
                         raise RuntimeError(
                             f"peer {p} g{g}: payload log shorter than "
                             f"commit ({a}+{len(datas)} < {c})")
+                    if self.membership is not None:
+                        datas = self._scrub_conf(g, a, datas)
                     if any(datas):
                         items.append((g, a, datas))
             if items:
@@ -1206,11 +1405,16 @@ class MeshClusterNode(FusedClusterNode):
                      timer_inc: Optional[np.ndarray] = None):
         if timer_inc is not None:
             # The shard_map'd step has no per-peer timer plumbing; the
-            # mesh runtime ticks lockstep only.  Fail loudly rather
-            # than silently ignoring a requested skew.
-            raise NotImplementedError(
-                "per-peer timer skew is not supported on the mesh "
-                "runtime (lockstep ticking only)")
+            # mesh runtime ticks lockstep only.  Fail loudly — with the
+            # typed error naming the limitation and the way out —
+            # rather than silently ignoring a requested skew.
+            from raftsql_tpu.parallel.sharded import MeshLockstepOnlyError
+            raise MeshLockstepOnlyError(
+                "MeshClusterNode ticks all peers in lockstep: per-peer "
+                "timer skew (timer_inc vector) is not supported on the "
+                "mesh runtime.  Use FusedClusterNode for skew "
+                "scenarios, or teach parallel/sharded.py's step to "
+                "shard a per-peer timer vector.")
         self.states, self.inboxes, pinfo_dev = self._sharded_step(
             self.states, self.inboxes, jnp.asarray(prop_n))
         return pinfo_dev, None      # mesh runtime: manual ticking only

@@ -42,12 +42,19 @@ import jax.numpy as jnp
 from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP,
                                 NO_VOTE, RaftConfig)
 from raftsql_tpu.core.state import (install_snapshot_state,
-                                    restore_peer_state, set_peer_progress)
+                                    restore_peer_state, set_group_config,
+                                    set_peer_progress)
+from raftsql_tpu.membership import (MembershipLagError, MembershipManager,
+                                    NotLeaderForChange)
+from raftsql_tpu.transport.codec import CONF_PREFIX as _CONF_PREFIX, \
+    is_conf_entry
 from raftsql_tpu.core.step import (IB_NCOLS, INFO_FIELDS, MSG_FIELDS,
                                    peer_step_packed)
 from raftsql_tpu.runtime.envelope import (DedupWindow, unwrap,
-                                          unwrap_snapshot, wrap,
-                                          wrap_snapshot)
+                                          unwrap_snapshot,
+                                          unwrap_snapshot_conf, wrap,
+                                          wrap_snapshot,
+                                          wrap_snapshot_conf)
 from raftsql_tpu.storage.log import PayloadLog
 from raftsql_tpu.storage.wal import WAL, split_uniform_runs, wal_exists
 from raftsql_tpu.transport.base import (AppendRec, ColRecs, ProposalRec,
@@ -265,6 +272,23 @@ class RaftNode:
             self._applied[g] = gl.log_len
         self._replay_groups = groups
         self.wal = WAL(data_dir, segment_bytes=cfg.wal_segment_bytes)
+        # Dynamic membership (raftsql_tpu/membership/): always on — a
+        # follower must recognize a conf entry the moment the first one
+        # ever commits.  Restore the active config from the WAL: the
+        # REC_CONF baseline, then conf ENTRIES committed above it, then
+        # appended-but-uncommitted ones back into the pending list.
+        self.membership = MembershipManager(
+            num_nodes, G, initial_voters=cfg.initial_voters) \
+            if num_nodes <= 64 else None
+        if self.membership is not None:
+            mm = self.membership
+            for g, gl in groups.items():
+                if mm.restore(g, gl.conf, gl.entries, gl.start,
+                              int(self._hard_np[g, 2])):
+                    self._patch_group_config(g, durable=False)
+        # Leader view cache for the promote catch-up gate ([G, P]
+        # next_idx from the last step's StepInfo).
+        self._next_idx = np.ones((G, num_nodes), np.int64)
         self._self_arr = jnp.asarray(self.self_id, jnp.int32)
         # timer_inc constants for the step call: index by advance_timers.
         self._ti_arr = (jnp.asarray(0, jnp.int32),
@@ -385,6 +409,8 @@ class RaftNode:
         transfers can ship exactly the window at their applied point."""
         if not data:
             return None
+        if data[:1] == _CONF_PREFIX and is_conf_entry(data):
+            return None        # membership entry — applied, never SQL
         pid, payload = unwrap(data)
         if pid is not None and self._dedup[group].seen(pid, idx):
             return None
@@ -400,6 +426,85 @@ class RaftNode:
         orders those safely internally; no other methods are
         cross-thread."""
         return self._dedup[group]
+
+    # ------------------------------------------------------------------
+    # dynamic membership (raftsql_tpu/membership/)
+
+    def _patch_group_config(self, g: int, durable: bool = True) -> None:
+        """Push group g's applied config into the device masks and
+        (durable=True) the WAL baseline.  Tick thread (or __init__)."""
+        mm = self.membership
+        vrow, jrow, selfv = mm.device_rows(g, self.self_id)
+        self.state = set_group_config(self.state, g, vrow, jrow, selfv)
+        c = mm.config(g)
+        with self._wal_lock:
+            self.wal.set_conf(g, c.index, 0, c.voters, c.joint,
+                              c.learners)
+        if durable:
+            self.metrics.conf_changes_applied += 1
+
+    def propose_conf(self, group: int, entry: bytes) -> None:
+        """Queue a conf entry — NO envelope wrap (conf apply is
+        idempotent by log index, and the publish plane recognizes conf
+        entries by their leading byte; an envelope would hide it)."""
+        with self._prop_lock:
+            self._props[group].append(entry)
+            self._prop_len[group] += 1
+            self._fwd_groups.add(group)
+        self._work_evt.set()
+
+    def member_change(self, group: int, op: str, peer: int) -> dict:
+        """Admin plane: add/remove/promote a peer slot of `group`.
+
+        Accepted at the group's leader only (NotLeaderForChange names
+        the hint to retry at); `promote` additionally requires the
+        learner to be CAUGHT UP — its replication point within one
+        append batch of the leader's commit — so a promotion can never
+        stall the new joint quorum behind a cold learner."""
+        if self.membership is None:
+            raise RuntimeError("membership requires num_peers <= 64")
+        if not 0 <= group < self.cfg.num_groups:
+            raise ValueError(f"group {group} out of range")
+        if self._last_role[group] != LEADER:
+            raise NotLeaderForChange(group, self.leader_of(group) + 1)
+        if op == "promote":
+            commit = int(self._hard_np[group, 2])
+            behind = commit - (int(self._next_idx[group, peer]) - 1)
+            if behind > self.cfg.max_entries_per_msg:
+                raise MembershipLagError(
+                    f"group {group}: learner {peer} is {behind} entries "
+                    f"behind commit {commit}; let catch-up finish before "
+                    "promoting")
+        entry = self.membership.make_change(group, op, peer)
+        self.propose_conf(group, entry)
+        return self.membership.describe(group)
+
+    def members_doc(self) -> dict:
+        """GET /members payload: per-group active config + leader."""
+        if self.membership is None:
+            return {"error": "membership requires num_peers <= 64"}
+        out = {}
+        for g in range(self.cfg.num_groups):
+            d = self.membership.describe(g)
+            d["leader"] = self.leader_of(g) + 1      # 1-based, 0 unknown
+            out[str(g)] = d
+        return {"num_peers": self.num_nodes, "groups": out,
+                "node": self.node_id}
+
+    def _membership_tick(self, info) -> None:
+        """Joint-transition driver: whichever peer currently leads a
+        joint group auto-proposes the LEAVE_JOINT (rate-limited), so a
+        leader crash between the two phases cannot wedge the group."""
+        mm = self.membership
+        if mm is None or not mm.joint_groups:
+            return
+        role = info.role
+        for g in list(mm.joint_groups):
+            if role[g] == LEADER:
+                entry = mm.maybe_leave(g, self._tick_no,
+                                       4 * self.cfg.election_ticks)
+                if entry is not None:
+                    self.propose_conf(g, entry)
 
     def leader_of(self, group: int) -> int:
         """Last known leader (0-based peer), -1 if unknown.
@@ -463,6 +568,10 @@ class RaftNode:
             echo = self._resp_echo[group].copy()
             rterm = self._resp_term[group].copy()
         ok = (echo >= reg_tick) & (rterm == term)
+        mm = self.membership
+        if mm is not None and not mm.is_default(group):
+            # Mask-weighted confirmation (joint: both majorities).
+            return mm.quorum_confirmed(group, ok, self.self_id)
         return int(ok.sum()) + 1 >= self.cfg.quorum
 
     # ------------------------------------------------------------------
@@ -745,6 +854,7 @@ class RaftNode:
             (pob, pinfo, nidx, margin))
         outbox = _view_outbox(pob)
         info = _view_info(pinfo, nidx)
+        self._next_idx = nidx           # promote catch-up gate cache
         self._timer_margin = max(int(margin), 1)
         t1 = time.monotonic()
 
@@ -754,6 +864,7 @@ class RaftNode:
         self._send_phase(outbox, info)  # … before sent …
         t3 = time.monotonic()
         self._publish_phase(info)       # … before published.
+        self._membership_tick(info)     # joint-transition driver
         t4 = time.monotonic()
         m.t_device_ms += (t1 - t0) * 1e3
         m.t_wal_ms += (t2 - t1) * 1e3
@@ -820,7 +931,8 @@ class RaftNode:
                 term[g] = rec.term
             if rec.last_idx <= max(self._applied[g], int(commit[g])):
                 continue
-            pairs, sm_blob = unwrap_snapshot(rec.blob)
+            conf, inner = unwrap_snapshot_conf(rec.blob)
+            pairs, sm_blob = unwrap_snapshot(inner)
             try:
                 self.snapshot_installer(g, rec.last_idx, sm_blob)
             except Exception as e:
@@ -850,6 +962,13 @@ class RaftNode:
                 self.state = install_snapshot_state(
                     self.state, g, rec.last_idx, rec.last_term, rec.term)
                 self._applied[g] = rec.last_idx
+            if conf is not None and self.membership is not None:
+                # Adopt the sender's active config at the transfer
+                # point (the skipped log range may contain the conf
+                # entries that built it).
+                cidx, centry = conf
+                if self.membership.apply(g, cidx, centry) is not None:
+                    self._patch_group_config(g)
             if self._local[g]:
                 # Our uncommitted leader-era proposals may or may not be
                 # inside the installed state; requeue them all — the
@@ -950,6 +1069,7 @@ class RaftNode:
         noop = np.asarray(info.noop)
         prop_acc = np.asarray(info.prop_accepted)
         app_from = np.asarray(info.app_from)
+        mm = self.membership
         w_rg: List[int] = []         # RANGE runs: group, start, count,
         w_rs: List[int] = []         # term — plus the flat per-entry
         w_rc: List[int] = []         # payload list in run order.
@@ -994,6 +1114,13 @@ class RaftNode:
                         zip(range(base + 1, base + 1 + n_acc), batch))
                     self.payload_log.put(g, base + 1, batch,
                                          [t_g] * n_acc)
+                    if mm is not None:
+                        # Conf entries entering the log as LEADER
+                        # appends: index them for apply-at-commit (one
+                        # leading-byte test per accepted proposal).
+                        for off, d in enumerate(batch):
+                            if d[:1] == _CONF_PREFIX and is_conf_entry(d):
+                                mm.note_appended(g, base + 1 + off, d)
                     if self.tracer is not None:
                         # Bind spans to their log indexes (envelope
                         # stripped — spans are keyed by plain content).
@@ -1016,6 +1143,16 @@ class RaftNode:
                 w_data.extend(rec.payloads[:n_app])
                 self.payload_log.put(g, start, rec.payloads,
                                      rec.ent_terms, new_len=new_len)
+                if mm is not None:
+                    if info.app_conflict[g]:
+                        # Clobbered suffix: conf entries in it never
+                        # commit here.
+                        mm.note_truncated(g, start)
+                    # Conf entries entering as FOLLOWER appends (normal
+                    # replication or host catch-up).
+                    for off, d in enumerate(rec.payloads[:n_app]):
+                        if d[:1] == _CONF_PREFIX and is_conf_entry(d):
+                            mm.note_appended(g, start + off, d)
                 if info.app_conflict[g] and self._local[g]:
                     # The new leader's suffix clobbered entries we
                     # appended as a (now deposed) leader: requeue their
@@ -1286,6 +1423,14 @@ class RaftNode:
                 # entries its installed state lacks — both diverge.
                 blob = wrap_snapshot(
                     self._dedup[g].pairs_upto(last_idx), blob)
+                mm = self.membership
+                if mm is not None and not mm.is_default(g):
+                    # The transfer skips the log: ship the active
+                    # config so the receiver cannot keep a voter set
+                    # from before the skipped conf entries.
+                    c = mm.config(g)
+                    blob = wrap_snapshot_conf(
+                        c.index, c.entry(0), blob)
                 batch_for(d).snapshots.append(SnapshotRec(
                     group=g, last_idx=last_idx,
                     last_term=self.payload_log.term_of(g, last_idx),
@@ -1374,6 +1519,20 @@ class RaftNode:
                         if p == data:
                             del fwd[k]
                             break
+            mm = self.membership
+            if mm is not None and mm.has_appended(g):
+                # Conf entries committing in this range: APPLY (device
+                # masks + WAL baseline) and SCRUB them from the SQL
+                # apply stream — the state machine sees an empty entry
+                # where the conf change sat (raft.go:84-87 parity).
+                # Index-driven: zero per-entry work on the hot path.
+                for idx, _noted in mm.take_committed(g, a, c):
+                    d = datas[idx - a - 1]
+                    if not is_conf_entry(d):
+                        continue          # stale note (overwritten slot)
+                    if mm.apply(g, idx, d) is not None:
+                        self._patch_group_config(g)
+                    datas[idx - a - 1] = b""
             if any(datas):
                 # RAW batch, one queue put per group per tick: the
                 # per-entry unwrap/dedup/utf-8 chain (~2.5 µs each, the

@@ -70,6 +70,9 @@ _HARD = struct.Struct("<BIQqQ")      # type, group, term, vote, commit
 _SNAP = struct.Struct("<BIQQ")       # type, group, index, term (also COMPACT)
 _RANGE = struct.Struct("<BIQQI")     # type, group, start, term, count
 _EPOCH = struct.Struct("<BBQ")       # type, kind (0 BEGIN / 1 END), no
+_CONFREC = struct.Struct("<BIQBQQQ")  # type, group, index, kind,
+#                                       voters, joint, learners (u64
+#                                       slot bitmasks — membership/)
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
@@ -84,6 +87,16 @@ REC_EPOCH = 6           # multi-step dispatch frame marker (see
                         # Replay ignores these; repair_epochs() uses
                         # BEGIN markers to atomically drop an
                         # uncommitted dispatch after a crash.
+REC_CONF = 7            # applied membership configuration baseline
+                        # (raftsql_tpu/membership/): written when a
+                        # committed conf-change entry APPLIES, carrying
+                        # the entry's log index + the full config
+                        # (kind, voter/joint/learner u64 bitmasks).
+                        # Replay keeps the last one per group; restart
+                        # recovery seeds the active config from it and
+                        # re-applies any conf ENTRIES committed above
+                        # it — so the active config survives even after
+                        # compaction unlinks the entries that built it.
 
 _SEG_RE = re.compile(r"^wal-(\d+)\.log$")
 # Single source of truth for the default lives in config (the CLI and
@@ -129,6 +142,9 @@ class GroupLog:
     entries: List[Tuple[int, bytes]] = field(default_factory=list)  # (term, data)
     start: int = 0
     start_term: int = 0
+    # Last applied-membership baseline (REC_CONF), or None:
+    # (entry_index, kind, voters_mask, joint_mask, learners_mask).
+    conf: Optional[Tuple[int, int, int, int, int]] = None
 
     @property
     def log_len(self) -> int:
@@ -275,6 +291,12 @@ class WAL:
         self._active_stats = _SegStats()
         self._closed_stats: Dict[str, _SegStats] = {}
         self._marker_floor: Dict[int, int] = {}
+        # Latest applied-membership baseline per group (set_conf),
+        # re-asserted into the active segment when compaction unlinks
+        # the segment that held it — same survival contract as hard
+        # states.  Seeded by the owning runtime after replay (set_conf
+        # is idempotent), not by this handle.
+        self._conf_latest: Dict[int, Tuple[int, int, int, int, int]] = {}
         self._open_active()
 
     @staticmethod
@@ -542,6 +564,27 @@ class WAL:
             return
         self._write(_SNAP.pack(REC_SNAPSHOT, group, index, term))
 
+    def set_conf(self, group: int, index: int, kind: int, voters: int,
+                 joint: int, learners: int) -> bool:
+        """Applied-membership baseline record (REC_CONF): the conf
+        entry at `index` has been APPLIED — replay's last-wins baseline
+        seeds the active config even after compaction drops the entry.
+
+        Durability ride-along: the record lands before the NEXT sync
+        barrier; a crash before it replays the same conf from the still
+        -committed log entry, so no extra fsync is needed here.  The
+        native C fast path has no conf writer — returns False there
+        (recovery then depends on the retained entries; the membership
+        runtimes force the Python backend via their chaos/fsio posture,
+        and document the native gap)."""
+        if self._lib is not None:
+            return False
+        self._conf_latest[group] = (index, kind, voters, joint, learners)
+        self._active_stats.hs.add(group)   # re-assert like a hard state
+        self._write(_CONFREC.pack(REC_CONF, group, index, kind,
+                                  voters, joint, learners))
+        return True
+
     def epoch_mark(self, no: int, end: bool) -> None:
         """Multi-step dispatch frame marker (REC_EPOCH): BEGIN before
         the dispatch's first record, END after its last (including the
@@ -700,6 +743,11 @@ class WAL:
                 st.bump(group, start + count - 1)
             elif rtype == REC_HARDSTATE:
                 st.hs.add(_HARD.unpack_from(body)[1])
+            elif rtype == REC_CONF:
+                # Same survival contract as a hard state: the group's
+                # baseline must be re-asserted before this segment may
+                # be unlinked (compact()'s _conf_latest re-write).
+                st.hs.add(_CONFREC.unpack_from(body)[1])
             elif rtype in (REC_SNAPSHOT, REC_COMPACT):
                 _, group, index, _t = _SNAP.unpack_from(body)
                 st.bump(group, index)
@@ -763,6 +811,12 @@ class WAL:
                 self.set_hardstate(g, *hard[g])
             if g in floors:
                 self._write_compact_rec(g, *floors[g])
+            conf = self._conf_latest.get(g)
+            if conf is not None and self._lib is None:
+                # The membership baseline must survive the unlink too:
+                # the conf ENTRY that built it may live only in the
+                # doomed segments.
+                self._write(_CONFREC.pack(REC_CONF, g, *conf))
         self.sync()
         for path in run:
             os.unlink(path)
@@ -913,4 +967,13 @@ class WAL:
                     # Confirms an implicit floor inferred from a forward
                     # entry gap (see ENTRY handling above).
                     gl.start_term = term
+            elif rtype == REC_CONF:
+                _, group, index, kind, voters, joint, learners = \
+                    _CONFREC.unpack_from(body)
+                gl = groups.setdefault(group, GroupLog())
+                # Last-wins applied-config baseline; conf entries
+                # committed above it re-apply on top during restore
+                # (runtime membership wiring).
+                if gl.conf is None or index >= gl.conf[0]:
+                    gl.conf = (index, kind, voters, joint, learners)
         return True

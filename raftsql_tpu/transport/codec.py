@@ -64,6 +64,58 @@ class FrameCorruptError(ValueError):
     the receiver."""
 
 
+# ---------------------------------------------------------------------------
+# Conf-change entries (dynamic membership, raftsql_tpu/membership/).
+#
+# A membership change travels THROUGH the replicated log as a marked
+# entry payload — the new record kind of the entry plane.  The first
+# byte discriminates against the other payload forms on the wire and in
+# the WAL: 0x01 = proposal envelope, 0x02/0x04 = snapshot wrappers
+# (runtime/envelope.py), printable bytes = bare SQL.  Conf entries are
+# NEVER enveloped (their apply is idempotent by log index, and the
+# publish plane must recognize them with one leading-byte test), and
+# they are scrubbed from the SQL apply stream at commit — the apply
+# plane sees an empty entry where a conf change sat, exactly like the
+# reference skipping empty/conf entries (raft.go:84-87).
+#
+# Every conf entry carries the FULL target configuration (voter mask,
+# joint mask, learner mask as u64 slot bitmasks — P <= 64), so applying
+# one is an unconditional set: re-delivery, forward-retry, and replay
+# are idempotent, and the newest entry alone describes the active
+# config.  Two-phase joint style (C_old,new -> C_new, one in flight per
+# group, raftsql_tpu/membership/manager.py):
+#   ENTER_JOINT: voters = C_new, joint = C_old  (both majorities rule)
+#   LEAVE_JOINT: voters = joint = C_new         (stable again)
+#   LEARNER:     voter masks unchanged, learner set edited (1-phase —
+#                learners are outside every quorum, so no joint needed)
+
+CONF_MAGIC = 0x03
+CONF_PREFIX = bytes([CONF_MAGIC])
+CONF_KIND_LEARNER = 1
+CONF_KIND_ENTER_JOINT = 2
+CONF_KIND_LEAVE_JOINT = 3
+_CONF = struct.Struct("<BBQQQ")     # magic, kind, voters, joint, learners
+
+
+def encode_conf_entry(kind: int, voters_mask: int, joint_mask: int,
+                      learners_mask: int) -> bytes:
+    return _CONF.pack(CONF_MAGIC, kind, voters_mask, joint_mask,
+                      learners_mask)
+
+
+def is_conf_entry(data: bytes) -> bool:
+    return len(data) == _CONF.size and data[0] == CONF_MAGIC
+
+
+def decode_conf_entry(data: bytes):
+    """(kind, voters_mask, joint_mask, learners_mask), or None when the
+    payload is not a conf entry."""
+    if not is_conf_entry(data):
+        return None
+    _, kind, voters, joint, learners = _CONF.unpack(data)
+    return kind, voters, joint, learners
+
+
 def encode_batch(batch: TickBatch) -> bytes:
     out = [_U32.pack(len(batch.votes))]
     for v in batch.votes:

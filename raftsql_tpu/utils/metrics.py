@@ -23,6 +23,12 @@ class NodeMetrics:
     compactions: int = 0
     snapshots_sent: int = 0
     snapshots_installed: int = 0
+    # Dynamic membership (raftsql_tpu/membership/): committed
+    # conf-change entries APPLIED by this node (device masks patched +
+    # WAL baseline written).  The companion gauges members_voters /
+    # members_learners are computed live from the manager at export
+    # time (runtime/db.py metrics()).
+    conf_changes_applied: int = 0
     # Fault counters (chaos/ harness + storage fsio shim): injected
     # message-plane faults and storage faults survived by this node.
     # Zero outside chaos runs; exported so a chaos'd deployment's
@@ -66,6 +72,7 @@ class NodeMetrics:
             "compactions": self.compactions,
             "snapshots_sent": self.snapshots_sent,
             "snapshots_installed": self.snapshots_installed,
+            "conf_changes_applied": self.conf_changes_applied,
             "faults": {
                 "dropped_msgs": self.faults_dropped_msgs,
                 "delayed_msgs": self.faults_delayed_msgs,

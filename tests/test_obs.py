@@ -268,6 +268,20 @@ def test_metrics_has_propose_commit_histogram(server):
     assert m["propose_commit_p50_ms"] <= m["propose_ack_p99_ms"]
 
 
+def test_metrics_exports_membership_state(server):
+    """Membership observability (raftsql_tpu/membership/): /metrics
+    carries the live per-cluster voter/learner slot totals and the
+    applied conf-change counter — the operator's view of the active
+    configuration's shape without scraping /members."""
+    status, data = _get(server, "/metrics")
+    assert status == 200
+    m = json.loads(data)
+    # 1 voter slot x 2 groups, no learners, nothing churned yet.
+    assert m["members_voters"] == 2
+    assert m["members_learners"] == 0
+    assert m["conf_changes_applied"] == 0
+
+
 # -- flight recorder ---------------------------------------------------
 
 def test_flight_recorder_dumps_on_invariant_failure(tmp_path,
