@@ -4,7 +4,8 @@
 PY ?= python
 SEED ?= 0
 
-.PHONY: all native test vet bench chaos chaos-membership trace clean
+.PHONY: all native test vet bench chaos chaos-membership chaos-procs \
+	trace clean
 
 # "Build" = compile the native C++ components (storage fast path).
 all: native
@@ -56,6 +57,20 @@ chaos-matrix:
 chaos-membership:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --family membership --seed $(SEED)
+
+# Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
+# over REAL server/main.py OS processes — leader-targeted + random
+# SIGKILL, SIGSTOP/SIGCONT stalls, a rolling-restart storm (clean
+# SIGTERM + same-port rebinds), env-injected disk faults
+# (RAFTSQL_FSIO_FAULTS: ENOSPC on a WAL write + hard process exit at a
+# WAL fsync) — under a live acked-PUT workload.  The seed runs TWICE:
+# schedule + invariant-verdict digests must match (committed history
+# crosses real kernel scheduling, so tick-for-tick replay is out of
+# scope on this plane — see README "Process-plane chaos").
+#   make chaos-procs SEED=17
+chaos-procs:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --procs --seed $(SEED)
 
 # Observability demo (raftsql_tpu/obs/): run a traced fused cluster and
 # emit Chrome trace-event JSON — load trace.json at ui.perfetto.dev or

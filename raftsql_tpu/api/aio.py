@@ -134,6 +134,12 @@ class _Conn(asyncio.Protocol):
                 self.busy = True
                 self.srv.loop.create_task(self._do_put(headers, body))
             elif method == b"GET":
+                if path == b"/healthz":
+                    # Readiness probe — parity with api/http.py.
+                    self.tr.write(_resp(200, b"OK",
+                                        self.srv.rdb.render_health()
+                                        .encode(), b"application/json"))
+                    continue
                 if path == b"/metrics":
                     payload = self.srv.rdb.render_metrics().encode()
                     self.tr.write(_resp(200, b"OK", payload,
@@ -181,6 +187,7 @@ class _Conn(asyncio.Protocol):
             clen = 0
             group = b"0"
             linear = False
+            token = None
             for line in head[1:]:
                 k, _, v = line.partition(b":")
                 k = k.strip().lower()
@@ -190,6 +197,10 @@ class _Conn(asyncio.Protocol):
                     group = v.strip()
                 elif k == b"x-consistency":
                     linear = v.strip().lower() == b"linear"
+                elif k == b"x-raft-retry-token":
+                    # Hex u64 retry token: pins the proposal's envelope
+                    # id so client re-sends apply exactly once.
+                    token = int(v.strip(), 16) & ((1 << 64) - 1)
         except (ValueError, IndexError):
             self._fail(b"malformed request\n")
             return None
@@ -201,7 +212,8 @@ class _Conn(asyncio.Protocol):
             return None
         body = bytes(buf[end + 4:total])
         del buf[:total]
-        return method, path, {"group": group, "linear": linear}, body
+        return method, path, {"group": group, "linear": linear,
+                              "token": token}, body
 
     def _fail(self, msg: bytes) -> None:
         self.tr.write(_resp(400, b"Bad Request", msg))
@@ -233,7 +245,7 @@ class _Conn(asyncio.Protocol):
         # seeing a 400 (the threaded plane's do_PUT catches everything).
         fut = None
         try:
-            fut = rdb.propose(query, group)
+            fut = rdb.propose(query, group, token=headers["token"])
             afut = self.srv.loop.create_future()
             fut.add_done_callback(
                 lambda err: self.srv.bridge.deliver(afut, err))

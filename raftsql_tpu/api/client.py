@@ -1,0 +1,264 @@
+"""Hardened HTTP client: retries, leader caching, exactly-once PUTs.
+
+The README's curl recipe and the process tests talked to the cluster
+with ad-hoc helpers that could only retry a PUT while the connection
+was REFUSED — once a server had accepted the bytes, a re-send risked a
+duplicate apply (the reference's content-keyed ack model has no request
+identity, db.go:112-118).  This client closes that gap and is what both
+the process-plane chaos nemesis (chaos/proc.py) and operators should
+use:
+
+  * per-request timeouts — a stalled (SIGSTOPped) server costs one
+    timeout, not a hung client;
+  * jittered exponential backoff across retries, rotating through the
+    cluster's nodes so a dead node is routed around;
+  * leader caching: a 421 Misdirected Request carries X-Raft-Leader
+    (linearizable reads, membership writes) — the hint is remembered
+    per group and tried first next time;
+  * RETRY TOKENS: every logical PUT draws one 64-bit token, sent as
+    X-Raft-Retry-Token on every attempt.  The server pins the
+    proposal's envelope id to it (runtime/envelope.py), so however many
+    attempts reach however many leaders across crashes and failovers,
+    the statement applies EXACTLY ONCE — which is what makes
+    retry-after-accept safe at all.
+
+Deterministic apply errors (HTTP 400) are never retried — the statement
+itself is wrong and a re-send cannot fix it.  503 (quorum/apply
+timeout), 421, connection errors, and request timeouts are retried
+until the caller's deadline.
+"""
+from __future__ import annotations
+
+import http.client
+import random
+import secrets
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class ClientError(Exception):
+    """Base class for terminal client failures."""
+
+
+class SQLError(ClientError):
+    """The server answered 400: the statement failed deterministically
+    (bad SQL, apply error).  Retrying cannot help."""
+
+    def __init__(self, status: int, text: str):
+        super().__init__(f"HTTP {status}: {text.strip()}")
+        self.status = status
+        self.text = text
+
+
+class Unavailable(ClientError):
+    """No node produced a definitive answer before the deadline."""
+
+
+_RETRYABLE_OS = (ConnectionRefusedError, ConnectionResetError,
+                 BrokenPipeError, socket.timeout, TimeoutError, OSError)
+
+
+class RaftSQLClient:
+    """Client for one cluster: `nodes` is a list of "host:port" (or
+    bare port numbers, meaning localhost) client-API endpoints, indexed
+    the way the caller thinks of node ids (0-based)."""
+
+    def __init__(self, nodes: List, timeout_s: float = 10.0,
+                 backoff_s: float = 0.05, backoff_cap_s: float = 1.0,
+                 rng: Optional[random.Random] = None):
+        self.nodes: List[Tuple[str, int]] = []
+        for n in nodes:
+            if isinstance(n, int):
+                self.nodes.append(("127.0.0.1", n))
+            else:
+                host, _, port = str(n).rpartition(":")
+                self.nodes.append((host or "127.0.0.1", int(port)))
+        self.timeout_s = timeout_s
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = rng or random.Random()
+        self._leader: Dict[int, int] = {}      # group -> node index
+        self._rr = 0                           # round-robin cursor
+
+    # -- low-level -----------------------------------------------------
+
+    def raw(self, node: int, method: str, path: str = "/",
+            body: str = "", headers: Optional[dict] = None,
+            timeout_s: Optional[float] = None):
+        """One request to one node, no retries: (status, headers, text).
+        Raises the underlying OSError on connection trouble — the retry
+        policy lives in the callers."""
+        host, port = self.nodes[node]
+        conn = http.client.HTTPConnection(
+            host, port, timeout=timeout_s or self.timeout_s)
+        try:
+            conn.request(method, path, body=body.encode("utf-8"),
+                         headers=headers or {})
+            r = conn.getresponse()
+            return r.status, dict(r.getheaders()), r.read().decode(
+                "utf-8", "replace")
+        finally:
+            conn.close()
+
+    def _order(self, group: int, node: Optional[int]) -> List[int]:
+        """Attempt order: pinned node only, else cached leader first,
+        then round-robin over the rest."""
+        if node is not None:
+            return [node]
+        n = len(self.nodes)
+        start = self._rr % n
+        self._rr += 1
+        order = [(start + i) % n for i in range(n)]
+        lead = self._leader.get(group)
+        if lead is not None and lead in order:
+            order.remove(lead)
+            order.insert(0, lead)
+        return order
+
+    def _note_leader(self, group: int, headers: dict) -> bool:
+        hint = headers.get("X-Raft-Leader")
+        if hint and hint.isdigit() and int(hint) > 0:
+            self._leader[group] = (int(hint) - 1) % len(self.nodes)
+            return True
+        return False
+
+    def _sleep_backoff(self, attempt: int, deadline: float) -> bool:
+        """Jittered exponential backoff; False when the deadline would
+        pass before the sleep ends."""
+        delay = min(self.backoff_cap_s,
+                    self.backoff_s * (2 ** min(attempt, 8)))
+        delay *= 0.5 + self._rng.random()      # 0.5x .. 1.5x jitter
+        if time.monotonic() + delay >= deadline:
+            return False
+        time.sleep(delay)
+        return True
+
+    # -- public API ----------------------------------------------------
+
+    def put(self, sql: str, group: int = 0, node: Optional[int] = None,
+            deadline_s: float = 60.0,
+            token: Optional[int] = None) -> None:
+        """Write SQL through consensus; returns once SOME attempt was
+        acked (204).  Safe to retry past acceptance: every attempt
+        carries the same retry token, so duplicates collapse server-side
+        to one apply.  400 raises SQLError immediately (deterministic);
+        everything else retries until the deadline."""
+        token = secrets.randbits(64) if token is None else token
+        headers = {"X-Raft-Retry-Token": f"{token:016x}"}
+        if group:
+            headers["X-Raft-Group"] = str(group)
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        last: object = None
+        while True:
+            for idx in self._order(group, node):
+                try:
+                    status, hdrs, text = self.raw(
+                        idx, "PUT", "/", sql, headers)
+                except _RETRYABLE_OS as e:
+                    last = e
+                    continue
+                if status == 204:
+                    return
+                if status == 400:
+                    raise SQLError(status, text)
+                if status == 421:
+                    self._note_leader(group, hdrs)
+                last = (status, text.strip())
+            attempt += 1
+            if time.monotonic() >= deadline \
+                    or not self._sleep_backoff(attempt, deadline):
+                raise Unavailable(
+                    f"PUT {sql!r} (group {group}): no ack before "
+                    f"deadline; last={last!r}")
+
+    def get(self, sql: str, group: int = 0, node: Optional[int] = None,
+            linear: bool = False, deadline_s: float = 60.0) -> str:
+        """Read SQL (idempotent — free to retry).  linear=True asks for
+        a linearizable read; 421 redirects chase X-Raft-Leader."""
+        headers = {}
+        if group:
+            headers["X-Raft-Group"] = str(group)
+        if linear:
+            headers["X-Consistency"] = "linear"
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        last: object = None
+        while True:
+            for idx in self._order(group, node):
+                try:
+                    status, hdrs, text = self.raw(
+                        idx, "GET", "/", sql, headers)
+                except _RETRYABLE_OS as e:
+                    last = e
+                    continue
+                if status == 200:
+                    return text
+                if status == 400:
+                    raise SQLError(status, text)
+                if status == 421:
+                    # Non-leader for a linear read: chase the hint
+                    # immediately (no backoff — the leader is up).
+                    if self._note_leader(group, hdrs) and node is None:
+                        break
+                last = (status, text.strip())
+            attempt += 1
+            if time.monotonic() >= deadline \
+                    or not self._sleep_backoff(attempt, deadline):
+                raise Unavailable(
+                    f"GET {sql!r} (group {group}): no answer before "
+                    f"deadline; last={last!r}")
+
+    def get_until(self, sql: str, want: str, group: int = 0,
+                  node: Optional[int] = None,
+                  deadline_s: float = 60.0,
+                  poll_s: float = 0.25) -> str:
+        """Poll an idempotent read until the answer matches `want`
+        (replication is async — the reference's own tests poll the same
+        way, raftsql_test.go:159-170)."""
+        deadline = time.monotonic() + deadline_s
+        last: object = None
+        while time.monotonic() < deadline:
+            try:
+                got = self.get(sql, group=group, node=node,
+                               deadline_s=min(
+                                   5.0, max(0.1,
+                                            deadline - time.monotonic())))
+                if got == want:
+                    return got
+                last = got
+            except (Unavailable, SQLError) as e:
+                last = e
+            time.sleep(poll_s)
+        raise Unavailable(f"GET {sql!r}: wanted {want!r}, last={last!r}")
+
+    def health(self, node: int,
+               timeout_s: float = 2.0) -> Optional[dict]:
+        """GET /healthz of one node; None when unreachable/stalled (a
+        SIGSTOPped process simply eats the timeout)."""
+        import json
+        try:
+            status, _, text = self.raw(node, "GET", "/healthz",
+                                       timeout_s=timeout_s)
+        except _RETRYABLE_OS:
+            return None
+        if status != 200:
+            return None
+        try:
+            return json.loads(text)
+        except ValueError:
+            return None
+
+    def wait_healthy(self, node: int, deadline_s: float = 30.0,
+                     poll_s: float = 0.2) -> dict:
+        """Block until the node answers /healthz (restart detection —
+        the probe the nemesis uses instead of a write)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            doc = self.health(node)
+            if doc is not None:
+                return doc
+            if time.monotonic() >= deadline:
+                raise Unavailable(f"node {node}: /healthz never came up")
+            time.sleep(poll_s)

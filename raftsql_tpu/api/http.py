@@ -65,10 +65,19 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             log.info("client error: %s", msg)
             self._send(400, (msg + "\n").encode("utf-8"))
 
+        def _retry_token(self) -> Optional[int]:
+            """X-Raft-Retry-Token: hex u64 pinning the proposal's
+            envelope id so a client-side re-send applies exactly once
+            (api/client.py sets one per logical PUT)."""
+            tok = self.headers.get("X-Raft-Retry-Token")
+            if tok is None:
+                return None
+            return int(tok, 16) & ((1 << 64) - 1)
+
         def do_PUT(self):
             try:
                 query, group = self._body(), self._group()
-                fut = rdb.propose(query, group)
+                fut = rdb.propose(query, group, token=self._retry_token())
                 try:
                     err = fut.wait(timeout_s)
                 except TimeoutError:
@@ -85,6 +94,14 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(204)
 
         def do_GET(self):
+            if self.path == "/healthz":
+                # Readiness: id, per-group role/leader/term/applied.
+                # Answering at all proves boot + replay completed (the
+                # nemesis's restart-detection probe, no write needed).
+                self._body()    # drain — keep-alive
+                self._send(200, rdb.render_health().encode(),
+                           ctype="application/json")
+                return
             if self.path == "/metrics":
                 self._body()    # drain — a leftover body corrupts keep-alive
                 self._send(200, rdb.render_metrics().encode(),

@@ -26,6 +26,15 @@ every scenario FAMILY (asymmetric partitions, per-peer clock skew,
 wire-frame corruption, ENOSPC, fsync stalls, compaction and
 InstallSnapshot crash interleavings, and the real TCP transport) —
 see the README's fault-matrix table.
+
+The PROCESS plane (chaos/proc.py, `make chaos-procs`) goes one level
+further down: a seeded nemesis over real `server/main.py` OS processes
+— SIGKILL, SIGSTOP/SIGCONT stalls, rolling-restart storms, and
+env-injected disk faults (RAFTSQL_FSIO_FAULTS) — under a live
+acked-PUT workload through the hardened api/client.py.  Its schedule
+and invariant VERDICTS are seed-deterministic; its committed history
+crosses real kernel scheduling and is not (README "Process-plane
+chaos").
 """
 from raftsql_tpu.chaos.invariants import (DurabilityLedger, ElectionSafety,
                                           InvariantViolation,
@@ -38,7 +47,10 @@ from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
                                         EnospcFault, FsyncFault, FsyncStall,
                                         MemberEvent, MembershipChaosPlan,
                                         NodeBoot, NodeChaosPlan, NodeCrash,
-                                        PartitionWindow, SkewWindow,
+                                        PartitionWindow, ProcChaosPlan,
+                                        ProcFsioSpec, ProcKill,
+                                        ProcRestartStorm, ProcStall,
+                                        SkewWindow,
                                         TcpChaosPlan, TcpRebindPlan,
                                         TornWriteFault,
                                         generate, generate_asym,
@@ -49,8 +61,10 @@ from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
                                         generate_node_plan,
                                         generate_skew,
                                         generate_snapshot_plan,
+                                        generate_procs,
                                         generate_stall, generate_tcp_plan,
                                         generate_tcp_rebind_plan)
+from raftsql_tpu.chaos.proc import ProcChaosRunner, ProcCluster
 from raftsql_tpu.chaos.scenarios import (FusedChaosRunner,
                                          MembershipChaosRunner,
                                          NodeClusterChaosRunner,
@@ -63,10 +77,12 @@ __all__ = [
     "CorruptWindow", "CrashEvent", "DelayWindow", "DropWindow",
     "EnospcFault", "FsyncFault", "FsyncStall", "MemberEvent",
     "MembershipChaosPlan", "NodeBoot", "NodeChaosPlan",
-    "NodeCrash", "PartitionWindow", "SkewWindow", "TcpChaosPlan",
+    "NodeCrash", "PartitionWindow", "ProcChaosPlan", "ProcChaosRunner",
+    "ProcCluster", "ProcFsioSpec", "ProcKill", "ProcRestartStorm",
+    "ProcStall", "SkewWindow", "TcpChaosPlan",
     "TcpRebindPlan", "TornWriteFault", "generate", "generate_asym",
     "generate_compact", "generate_corrupt_plan", "generate_enospc",
-    "generate_membership_plan", "generate_node_plan",
+    "generate_membership_plan", "generate_node_plan", "generate_procs",
     "generate_skew", "generate_snapshot_plan", "generate_stall",
     "generate_tcp_plan", "generate_tcp_rebind_plan",
     "DurabilityLedger", "ElectionSafety", "InvariantViolation",

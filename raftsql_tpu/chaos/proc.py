@@ -1,0 +1,622 @@
+"""Process-plane chaos: a seeded nemesis over REAL server processes.
+
+The harness's other runners (chaos/scenarios.py) stop at in-process
+runtimes; ROADMAP lists "multi-process chaos (real SIGKILL of server
+processes)" as the last open chaos frontier, and the consensus-testing
+literature (arXiv:2004.05074, arXiv:1905.10786) locates exactly the
+bugs in-process simulation cannot reach: real SIGKILL timing against a
+kernel-scheduled tick thread, stalled-but-not-dead processes, and
+clients retrying writes across leader failure.  This module drives a
+real N-process cluster (server/main.py children, TcpTransport between
+them, HTTP on top) through a seeded `ProcChaosPlan`:
+
+  * SIGKILL crashes — leader-targeted (resolved live via /healthz) and
+    random — with respawn on the SAME ports and data dirs;
+  * SIGSTOP/SIGCONT stalls — the GC-pause / VM-freeze failure mode: a
+    frozen leader must be deposed and rejoin as a follower, with every
+    write acked before the stall intact;
+  * rolling-restart storms — clean SIGTERM stops (the graceful-shutdown
+    path) with immediate same-port rebinds, one node at a time;
+  * env-injected storage faults — RAFTSQL_FSIO_FAULTS specs
+    (storage/fsio.py) give children ENOSPC at a chosen WAL write and a
+    hard process exit at a chosen WAL fsync, so torn-tail and
+    epoch-repair recovery runs in real processes.
+
+A workload of acked PUTs (via the hardened api/client.py, whose retry
+tokens make re-sends across crashes exactly-once) feeds the ledger;
+live /healthz polling feeds the single-leader invariant; after the
+heal window the survivors must CONVERGE (identical rows everywhere, a
+superset of every acked write, each acked write exactly once), and a
+post-mortem replays every surviving WAL dir and re-opens every SQLite
+DB to re-prove durability from disk alone.
+
+Determinism contract (the WEAKEST in the harness, documented in the
+README fault matrix): the SCHEDULE is a pure function of the seed and
+the invariant VERDICTS must reproduce — `make chaos-procs` runs one
+seed twice and compares schedule + verdict digests — but the committed
+history crosses three kernels' schedulers and is not bit-reproducible.
+On any invariant failure the runner dumps a flight bundle
+(per-process log tails, /metrics, /trace, WAL dir listings) via
+obs/flight.py before re-raising.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import sqlite3
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from raftsql_tpu.api.client import RaftSQLClient, SQLError, Unavailable
+from raftsql_tpu.chaos.invariants import ElectionSafety, InvariantViolation
+from raftsql_tpu.chaos.schedule import LEADER_TARGET, ProcChaosPlan
+from raftsql_tpu.storage.fsio import EXIT_CODE_FSYNC_CRASH
+
+# server/main.py EXIT_CODE_FATAL without importing the server module
+# (it pulls the whole engine; the nemesis stays engine-import-free so
+# it can babysit children that ARE the engine).
+EXIT_CODE_FATAL = 70
+
+_LEADER = "leader"
+
+
+def _reserve_ports(n: int):
+    import socket
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ProcCluster:
+    """N `server/main.py` OS processes on localhost — the Procfile
+    topology under nemesis control.  SIGTERM is "clean stop" (the
+    graceful-shutdown handler flushes the WAL and exits 0); SIGKILL is
+    "crash"; SIGSTOP/SIGCONT is "stall"."""
+
+    def __init__(self, workdir: str, peers: int = 3, groups: int = 1,
+                 tick: float = 0.02, http_engine: str = "aio"):
+        self.workdir = str(workdir)
+        self.peers = peers
+        self.groups = groups
+        self.tick = tick
+        self.http_engine = http_engine
+        ports = _reserve_ports(2 * peers)
+        self.peer_ports, self.http_ports = ports[:peers], ports[peers:]
+        self.cluster = ",".join(f"http://127.0.0.1:{p}"
+                                for p in self.peer_ports)
+        self.procs: List[Optional[subprocess.Popen]] = [None] * peers
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self.env_base = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=repo_root + (
+                os.pathsep + os.environ["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH") else ""))
+        self.env_base.pop("RAFTSQL_FSIO_FAULTS", None)
+        os.makedirs(self.workdir, exist_ok=True)
+
+    def spawn(self, i: int, fsio_spec: Optional[str] = None) -> None:
+        """(Re)spawn peer i — same ports, same data dir, WAL replay.
+        `fsio_spec` rides RAFTSQL_FSIO_FAULTS into the child."""
+        assert self.procs[i] is None or self.procs[i].poll() is not None
+        env = dict(self.env_base)
+        if fsio_spec:
+            env["RAFTSQL_FSIO_FAULTS"] = fsio_spec
+        logf = open(os.path.join(self.workdir, f"node{i + 1}.log"), "ab")
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "raftsql_tpu.server.main",
+             "--id", str(i + 1), "--cluster", self.cluster,
+             "--port", str(self.http_ports[i]),
+             "--tick", str(self.tick), "--groups", str(self.groups),
+             "--http-engine", self.http_engine],
+            cwd=self.workdir, env=env, stdout=logf, stderr=logf)
+        logf.close()      # child inherited the fd
+
+    def alive(self, i: int) -> bool:
+        p = self.procs[i]
+        return p is not None and p.poll() is None
+
+    def exit_code(self, i: int) -> Optional[int]:
+        """Exit code if peer i's process has died, else None."""
+        p = self.procs[i]
+        if p is None:
+            return None
+        return p.poll()
+
+    def sigkill(self, i: int) -> None:
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=15)
+
+    def sigterm(self, i: int, timeout: float = 15.0) -> Optional[int]:
+        """Clean stop; returns the exit code (0 = graceful)."""
+        p = self.procs[i]
+        if p is None:
+            return None
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        return p.returncode
+
+    def sigstop(self, i: int) -> None:
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGSTOP)
+
+    def sigcont(self, i: int) -> None:
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGCONT)
+
+    def stop_all(self) -> List[Optional[int]]:
+        codes = []
+        for i in range(self.peers):
+            p = self.procs[i]
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGCONT)   # a stalled child first
+        for i in range(self.peers):
+            codes.append(self.sigterm(i))
+        return codes
+
+    def data_dir(self, i: int) -> str:
+        return os.path.join(self.workdir, f"raftsql-{i + 1}")
+
+    def db_path(self, i: int) -> str:
+        return os.path.join(self.workdir, f"raftsql-{i + 1}.db")
+
+    def log_tail(self, i: int, nbytes: int = 4096) -> str:
+        path = os.path.join(self.workdir, f"node{i + 1}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - nbytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+
+class ProcChaosRunner:
+    """Drive a ProcChaosPlan against a real cluster; see module doc."""
+
+    def __init__(self, plan: ProcChaosPlan, workdir: str,
+                 http_engine: str = "aio"):
+        self.plan = plan
+        self.cluster = ProcCluster(workdir, peers=plan.peers,
+                                   groups=plan.groups,
+                                   http_engine=http_engine)
+        self.client = RaftSQLClient(
+            [f"127.0.0.1:{p}" for p in self.cluster.http_ports],
+            timeout_s=3.0)
+        self.safety = ElectionSafety()
+        self.acked: List[str] = []           # ledger: values acked 204
+        self._acked_lock = threading.Lock()
+        self._stop_workload = threading.Event()
+        self._workload_err: Optional[BaseException] = None
+        # peer -> tick at which to respawn; peer -> stalled flag.
+        self._down_until: Dict[int, int] = {}
+        self._stalled: Set[int] = set()
+        self.report = {
+            "kills": 0, "stalls": 0, "storm_restarts": 0,
+            "respawns": 0, "fsio_exits": 0, "fatal_exits": 0,
+            "unexpected_exits": 0, "acked": 0, "graceful_stops": 0,
+        }
+        self.verdicts: Dict[str, str] = {}
+
+    # -- workload ------------------------------------------------------
+
+    def _workload(self) -> None:
+        """Acked-PUT feed: unique values, one retry token per value, so
+        every 204 is a durability promise the post-mortem can hold the
+        cluster to.  Engine-death 400s and deadline misses leave the
+        value UNACKED (no promise) and move on."""
+        n = 0
+        while not self._stop_workload.is_set():
+            val = f"w{n}"
+            n += 1
+            try:
+                self.client.put(
+                    f"INSERT INTO chaos (v) VALUES ('{val}')",
+                    deadline_s=8.0)
+                with self._acked_lock:
+                    self.acked.append(val)
+            except (SQLError, Unavailable):
+                pass
+            except BaseException as e:       # noqa: BLE001 - surfaced
+                self._workload_err = e
+                return
+            time.sleep(0.08)
+
+    # -- nemesis helpers -----------------------------------------------
+
+    def _healthz_all(self) -> Dict[int, Optional[dict]]:
+        docs: Dict[int, Optional[dict]] = {}
+        for i in range(self.plan.peers):
+            if not self.cluster.alive(i) or i in self._stalled:
+                docs[i] = None
+            else:
+                docs[i] = self.client.health(i, timeout_s=1.0)
+        return docs
+
+    def _resolve(self, peer: int, docs: Dict[int, Optional[dict]]) -> int:
+        """LEADER_TARGET → whoever reports role=leader for group 0 (a
+        live node's own view wins; fall back to any live node's leader
+        hint, then to the lowest live peer)."""
+        if peer != LEADER_TARGET:
+            return peer
+        for i, doc in sorted(docs.items()):
+            if doc and doc["groups"].get("0", {}).get("role") == _LEADER:
+                return i
+        for i, doc in sorted(docs.items()):
+            if doc:
+                lead = int(doc["groups"].get("0", {}).get("leader", 0))
+                if lead > 0:
+                    return lead - 1
+        for i in range(self.plan.peers):
+            if self.cluster.alive(i) and i not in self._stalled:
+                return i
+        return 0
+
+    def _observe(self, t: int, docs: Dict[int, Optional[dict]]) -> None:
+        """Feed /healthz snapshots to the single-leader invariant.
+        Commit monotonicity is NOT asserted on this plane: /healthz
+        reads the live cache, and a SIGKILL may legally roll an
+        observed-but-unsynced commit index back to the WAL's."""
+        P, G = self.plan.peers, self.plan.groups
+        roles = np.full((P, G), -1, np.int64)
+        terms = np.zeros((P, G), np.int64)
+        code = {"follower": 0, "candidate": 1, _LEADER: 2,
+                "precandidate": 3}
+        for i, doc in docs.items():
+            if not doc:
+                continue
+            for g in range(G):
+                row = doc["groups"].get(str(g))
+                if row:
+                    roles[i, g] = code.get(row.get("role"), -1)
+                    terms[i, g] = int(row.get("term", 0))
+        self.safety.observe(t, roles, terms)
+
+    def _handle_exits(self, t: int) -> None:
+        """Unscheduled child deaths: injected crash points (exit 86),
+        fatal-posture engine deaths (exit 70, e.g. injected ENOSPC),
+        or a real bug (anything else — still respawned, but counted
+        separately so the gate can flag it)."""
+        for i in range(self.plan.peers):
+            if i in self._down_until or i in self._stalled:
+                continue
+            code = self.cluster.exit_code(i)
+            if code is None:
+                continue
+            if code == EXIT_CODE_FSYNC_CRASH:
+                self.report["fsio_exits"] += 1
+            elif code == EXIT_CODE_FATAL:
+                self.report["fatal_exits"] += 1
+            else:
+                self.report["unexpected_exits"] += 1
+            self._down_until[i] = t + 2      # the operator reacts fast
+
+    def _respawn_due(self, t: int) -> None:
+        for i in [i for i, d in self._down_until.items() if d <= t]:
+            del self._down_until[i]
+            # Faulted env specs are first-boot only: the crash point
+            # fired, the disk "recovered", the respawn runs clean.
+            self.cluster.spawn(i)
+            self.report["respawns"] += 1
+
+    # -- phases --------------------------------------------------------
+
+    def _boot(self) -> None:
+        """Spawn everyone (with their env fault specs) and wait healthy.
+        A low-threshold env fault may fire DURING boot — the first
+        election's hard-state writes count too — so a child death here
+        is scored like any other and the peer is respawned clean."""
+        spec_of = {f.peer: f.spec for f in self.plan.fsio}
+        for i in range(self.plan.peers):
+            self.cluster.spawn(i, fsio_spec=spec_of.get(i))
+        deadline = time.monotonic() + 90.0
+        pending = set(range(self.plan.peers))
+        while pending:
+            if time.monotonic() > deadline:
+                raise Unavailable(
+                    f"nodes {sorted(pending)} never became healthy")
+            for i in sorted(pending):
+                code = self.cluster.exit_code(i)
+                if code is not None:
+                    if code == EXIT_CODE_FSYNC_CRASH:
+                        self.report["fsio_exits"] += 1
+                    elif code == EXIT_CODE_FATAL:
+                        self.report["fatal_exits"] += 1
+                    else:
+                        self.report["unexpected_exits"] += 1
+                    self.cluster.spawn(i)
+                    self.report["respawns"] += 1
+                    continue
+                if self.client.health(i) is not None:
+                    pending.discard(i)
+            time.sleep(0.3)
+        # Idempotent so a cross-call retry (fresh token) after an
+        # engine-death 400 cannot fail on its own success.
+        create_deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                self.client.put(
+                    "CREATE TABLE IF NOT EXISTS chaos (v text)",
+                    deadline_s=15.0)
+                return
+            except (SQLError, Unavailable):
+                if time.monotonic() > create_deadline:
+                    raise
+                time.sleep(0.5)
+
+    def _usable(self, p: int) -> bool:
+        return self.cluster.alive(p) and p not in self._stalled \
+            and p not in self._down_until
+
+    def _script(self) -> None:
+        """Run the scripted phase.  Events are DUE at their tick but
+        DEFERRED — not dropped — while their target cannot take the
+        fault (already dead of an injected disk fault, mid-respawn, or
+        stalled): a nemesis that silently skips a scheduled kill makes
+        the fired-families verdict a coin flip.  The script runs past
+        plan.ticks (bounded) until every event has fired."""
+        plan = self.plan
+        kills = sorted(plan.kills, key=lambda k: k.tick)
+        stalls = sorted(plan.stalls, key=lambda s: s.tick)
+        storm_jobs = sorted(
+            (storm.tick + k * storm.gap, k)
+            for storm in plan.storms for k in range(plan.peers))
+        cont_at: Dict[int, int] = {}        # tick -> peer to SIGCONT
+        max_script = plan.ticks + 80
+        t = 0
+        while True:
+            docs = self._healthz_all()
+            self._observe(t, docs)
+            for k in list(kills):
+                if k.tick > t:
+                    break
+                p = self._resolve(k.peer, docs)
+                if self._usable(p):
+                    self.cluster.sigkill(p)
+                    self._down_until[p] = t + k.down
+                    self.report["kills"] += 1
+                    kills.remove(k)
+            for s in list(stalls):
+                if s.tick > t:
+                    break
+                p = self._resolve(s.peer, docs)
+                if self._usable(p):
+                    self.cluster.sigstop(p)
+                    self._stalled.add(p)
+                    cont_at[t + s.ticks] = p
+                    self.report["stalls"] += 1
+                    stalls.remove(s)
+            for (due, p) in list(storm_jobs):
+                if due > t:
+                    break
+                if self._usable(p):
+                    code = self.cluster.sigterm(p)
+                    if code == 0:
+                        self.report["graceful_stops"] += 1
+                    self.cluster.spawn(p)   # immediate same-port rebind
+                    self.report["storm_restarts"] += 1
+                    storm_jobs.remove((due, p))
+            p = cont_at.pop(t, None)
+            if p is not None:
+                self.cluster.sigcont(p)
+                self._stalled.discard(p)
+            self._handle_exits(t)
+            self._respawn_due(t)
+            time.sleep(plan.tick_s)
+            if self._workload_err is not None:
+                raise self._workload_err
+            t += 1
+            pending = kills or stalls or storm_jobs or cont_at
+            if (t >= plan.ticks and not pending) or t >= max_script:
+                break
+        # End of script: everyone up and running for the heal window.
+        for p in list(self._stalled):
+            self.cluster.sigcont(p)
+            self._stalled.discard(p)
+        for i in list(self._down_until):
+            del self._down_until[i]
+            self.cluster.spawn(i)
+            self.report["respawns"] += 1
+        self._handle_exits(t)
+        self._respawn_due(t + 3)
+        for h in range(plan.heal_ticks):
+            docs = self._healthz_all()
+            self._observe(t + 1 + h, docs)
+            self._handle_exits(t + 1 + h)
+            self._respawn_due(t + 1 + h)
+            time.sleep(plan.tick_s)
+
+    def _converge(self, deadline_s: float = 60.0) -> List[str]:
+        """Every node must answer the full ordered table identically,
+        covering every acked write exactly once.  Returns the rows."""
+        with self._acked_lock:
+            acked = list(self.acked)
+        want_rows = {f"|{v}|" for v in acked}
+        deadline = time.monotonic() + deadline_s
+        last: object = None
+        query = "SELECT v FROM chaos ORDER BY v"
+        while time.monotonic() < deadline:
+            answers = []
+            try:
+                for i in range(self.plan.peers):
+                    answers.append(self.client.get(
+                        query, node=i, deadline_s=10.0))
+            except (Unavailable, SQLError) as e:
+                last = e
+                time.sleep(0.5)
+                continue
+            rows = answers[0].splitlines()
+            if all(a == answers[0] for a in answers) \
+                    and want_rows.issubset(rows):
+                dup = [v for v in acked if rows.count(f"|{v}|") != 1]
+                if dup:
+                    raise InvariantViolation(
+                        f"exactly-once violated: acked values applied "
+                        f"more than once: {dup[:5]} "
+                        f"(of {len(dup)})")
+                return rows
+            last = [len(a.splitlines()) for a in answers]
+            time.sleep(0.5)
+        raise InvariantViolation(
+            f"survivors failed to converge on {len(acked)} acked "
+            f"writes before the deadline; last={last!r}")
+
+    def _post_mortem(self) -> None:
+        """Durability from DISK alone: replay every node's WAL dir and
+        re-open every SQLite DB after the graceful stop — every acked
+        write must be in every node's committed WAL prefix (exactly
+        once, post-dedup) and in every rebuilt SQLite table."""
+        from raftsql_tpu.runtime.envelope import unwrap
+        from raftsql_tpu.storage.wal import WAL
+        with self._acked_lock:
+            acked = list(self.acked)
+        for i in range(self.plan.peers):
+            groups = WAL.replay(self.cluster.data_dir(i))
+            gl = groups.get(0)
+            if gl is None:
+                raise InvariantViolation(
+                    f"node {i + 1}: WAL replay has no group 0")
+            committed = gl.entries[:max(0, gl.hard.commit - gl.start)]
+            seen_pids: Set[int] = set()
+            values: List[str] = []
+            for (_term, data) in committed:
+                if not data:
+                    continue
+                pid, payload = unwrap(data)
+                if pid is not None:
+                    if pid in seen_pids:
+                        continue             # retry duplicate: one apply
+                    seen_pids.add(pid)
+                sql = payload.decode("utf-8", "replace")
+                if "VALUES ('" in sql:
+                    values.append(sql.split("('", 1)[1].split("')")[0])
+            missing = [v for v in acked if v not in set(values)]
+            if missing:
+                raise InvariantViolation(
+                    f"node {i + 1}: {len(missing)} acked writes missing "
+                    f"from the committed WAL prefix, e.g. {missing[:5]}")
+            dups = {v for v in acked if values.count(v) != 1}
+            if dups:
+                raise InvariantViolation(
+                    f"node {i + 1}: acked writes applied more than once "
+                    f"in the WAL apply stream: {sorted(dups)[:5]}")
+            # The SQLite file the stopped process left behind IS the
+            # applied state — read it cold.
+            conn = sqlite3.connect(self.cluster.db_path(i))
+            try:
+                rows = [r[0] for r in conn.execute(
+                    "SELECT v FROM chaos")]
+            finally:
+                conn.close()
+            missing = [v for v in acked if v not in set(rows)]
+            if missing:
+                raise InvariantViolation(
+                    f"node {i + 1}: {len(missing)} acked writes missing "
+                    f"from the SQLite state, e.g. {missing[:5]}")
+
+    # -- flight bundle -------------------------------------------------
+
+    def _flight_dump(self, err: BaseException) -> None:
+        from raftsql_tpu.obs.flight import FlightRecorder
+        bundle: dict = {"plan": self.plan.describe(),
+                        "schedule_digest": self.plan.digest(),
+                        "report": dict(self.report),
+                        "acked": len(self.acked),
+                        "logs": {}, "metrics": {}, "trace": {},
+                        "wal_dirs": {}}
+        for i in range(self.plan.peers):
+            bundle["logs"][i] = self.cluster.log_tail(i)
+            d = self.cluster.data_dir(i)
+            try:
+                bundle["wal_dirs"][i] = sorted(
+                    f"{f} ({os.path.getsize(os.path.join(d, f))}B)"
+                    for f in os.listdir(d))
+            except OSError:
+                bundle["wal_dirs"][i] = []
+            if self.cluster.alive(i) and i not in self._stalled:
+                try:
+                    _, _, bundle["metrics"][i] = self.client.raw(
+                        i, "GET", "/metrics", timeout_s=2.0)
+                    _, _, bundle["trace"][i] = self.client.raw(
+                        i, "GET", "/trace", timeout_s=2.0)
+                except OSError:
+                    pass
+        FlightRecorder().dump(
+            f"procs-seed{self.plan.seed}", repr(err), meta=bundle)
+
+    # -- entry ---------------------------------------------------------
+
+    def _verdict_digest(self) -> str:
+        """Hash of what MUST reproduce across runs of one seed: the
+        schedule, the per-invariant verdicts, and which fault families
+        actually fired (booleans — counts are wall-clock-scheduled)."""
+        r = self.report
+        doc = {
+            "schedule": self.plan.digest(),
+            "invariants": dict(self.verdicts),
+            "families": {
+                "sigkill": r["kills"] >= len(self.plan.kills),
+                "sigstop": r["stalls"] >= len(self.plan.stalls),
+                "restart_storm": r["storm_restarts"]
+                >= self.plan.peers * len(self.plan.storms),
+                "enospc": r["fatal_exits"] >= 1,
+                "exit_fsync": r["fsio_exits"] >= 1,
+                "unexpected_exits": r["unexpected_exits"] == 0,
+            },
+        }
+        blob = json.dumps(doc, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def run(self) -> dict:
+        wt = threading.Thread(target=self._workload, daemon=True,
+                              name="proc-chaos-workload")
+        try:
+            self._boot()
+            wt.start()
+            try:
+                self._script()
+            finally:
+                self._stop_workload.set()
+                wt.join(timeout=30)
+            self.verdicts["single_leader"] = "pass"   # observe() raised
+            self._converge()
+            self.verdicts["convergence"] = "pass"
+            self.verdicts["exactly_once"] = "pass"
+            codes = self.cluster.stop_all()
+            self.report["graceful_stops"] += sum(
+                1 for c in codes if c == 0)
+            self._post_mortem()
+            self.verdicts["durability"] = "pass"
+        except BaseException as e:
+            self._stop_workload.set()
+            self._flight_dump(e)
+            raise
+        finally:
+            self.cluster.stop_all()
+        self.report["acked"] = len(self.acked)
+        return {"schedule_digest": self.plan.digest(),
+                "result_digest": self._verdict_digest(),
+                "seed": self.plan.seed, **self.report}

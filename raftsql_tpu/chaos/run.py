@@ -4,6 +4,7 @@ scenarios and prove they reproduce.
     python -m raftsql_tpu.chaos.run --seed 0 --ticks 240 --runs 2
     python -m raftsql_tpu.chaos.run --matrix --seed 0
     python -m raftsql_tpu.chaos.run --family enospc --seed 3
+    python -m raftsql_tpu.chaos.run --procs --seed 0
 
 Default mode generates the seed's full ChaosSchedule (>= 2 partitions,
 >= 2 crash/restart events, >= 1 injected fsync fault, plus a torn-write
@@ -26,6 +27,15 @@ matrix (ROADMAP open items → chaos/schedule.py generators):
     membership       add/promote/remove churn + node replacement under
                      faults (lockstep plane, raftsql_tpu/membership/)
     tcp_rebind       crash/restart with port rebinding (REAL TCP transport)
+
+--procs is the PROCESS plane (`make chaos-procs`): a seeded nemesis
+over real `server/main.py` OS processes — SIGKILL (leader-targeted and
+random), SIGSTOP/SIGCONT stalls, a rolling-restart storm, and
+env-injected disk faults (RAFTSQL_FSIO_FAULTS: ENOSPC + a hard process
+exit at a WAL fsync) — under a live acked-PUT workload.  The seed runs
+twice; schedule and VERDICT digests must match (the committed history
+crosses real kernel scheduling and is not bit-reproducible — the
+weakest determinism tier, like `tcp`).
 
 Every family except `tcp` is run twice and must reproduce identical
 schedule + result digests.  The TCP family crosses real kernel sockets,
@@ -112,6 +122,45 @@ def _digests(r: dict):
             r.get("result_digest"))
 
 
+def run_procs(seed: int, ticks: int, runs: int = 2) -> int:
+    """Process-plane chaos: run the seed `runs` times over fresh work
+    dirs; every run must pass every invariant (violations raise), every
+    scripted fault family must fire, and all runs must agree on
+    schedule + verdict digests."""
+    from raftsql_tpu.chaos.proc import ProcChaosRunner
+    from raftsql_tpu.chaos.schedule import generate_procs
+
+    plan = generate_procs(seed, ticks=ticks)
+    reports = []
+    for run in range(runs):
+        with tempfile.TemporaryDirectory(prefix="raftsql-procs-") as d:
+            r = ProcChaosRunner(plan, d).run()
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+    ok = True
+    for r in reports:
+        ok &= _check(
+            r["kills"] >= len(plan.kills) and r["stalls"]
+            >= len(plan.stalls)
+            and r["storm_restarts"] >= plan.peers * len(plan.storms)
+            and r["fsio_exits"] >= 1 and r["fatal_exits"] >= 1,
+            f"procs: a scripted fault family never fired ({r})")
+        ok &= _check(r["unexpected_exits"] == 0,
+                     f"procs: a server died of something unscripted "
+                     f"({r})")
+    digests = {(r["schedule_digest"], r["result_digest"])
+               for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"procs: non-reproducible verdicts: {digests}")
+    if ok:
+        print(f"chaos procs ok: seed={seed} "
+              f"schedule={reports[0]['schedule_digest']} "
+              f"verdict={reports[0]['result_digest']} (x{runs} "
+              f"identical)")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -151,9 +200,17 @@ def main(argv=None) -> int:
     ap.add_argument("--family", action="append", default=None,
                     help="run only this family (repeatable; implies "
                          "--matrix)")
+    ap.add_argument("--procs", action="store_true",
+                    help="process-plane nemesis over real server "
+                         "processes (make chaos-procs)")
+    ap.add_argument("--proc-ticks", type=int,
+                    default=int(os.environ.get("PROC_TICKS", "80")),
+                    help="host ticks for the --procs script phase")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.procs:
+        return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:
         return run_matrix(args.seed, only=args.family)
 

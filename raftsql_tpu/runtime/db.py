@@ -70,8 +70,15 @@ def iter_plain_batches(item):
         yield from item[1]
 
 
-def _expand_commit_item(item, node=None):
+def _expand_commit_item(item, node=None, dups=None):
     """Normalize a commit_q item to per-entry (group, index, sql) tuples.
+
+    `dups` (optional list) collects (group, index, sql) for committed
+    entries the dedup window SKIPPED — a client-retried or
+    forward-retried duplicate that already applied.  The caller must
+    still ACK those by query identity (the retry's client is waiting on
+    this very commit; without the ack a PUT retried across a crash
+    would hang forever even though its first copy applied).
 
     Four forms, discriminated explicitly:
       - (RAW_BATCH, group, base_idx, [raw_bytes, ...]) — the live
@@ -105,7 +112,10 @@ def _expand_commit_item(item, node=None):
             pid, payload = unwrap(data)
             if pid is not None and dedup is not None \
                     and dedup.seen(pid, base + 1 + off):
-                continue                    # forward-retry duplicate
+                if dups is not None:        # retry duplicate: ack, no apply
+                    dups.append((g, base + 1 + off,
+                                 payload.decode("utf-8")))
+                continue
             out.append((g, base + 1 + off, payload.decode("utf-8")))
         return out
     if item[0] is RAW_PLAIN:
@@ -307,7 +317,8 @@ class RaftDB:
             # from the live publish phase (runtime/node.py) — expanded
             # (unwrap/dedup/decode) HERE so the tick thread pays one
             # queue put per group and none of the per-entry Python.
-            run = _expand_commit_item(item, self.pipe.node)
+            dups: list = []
+            run = _expand_commit_item(item, self.pipe.node, dups)
             stop = False
             if not replay:
                 while len(run) < 256:
@@ -326,9 +337,16 @@ class RaftDB:
                     if nxt is CLOSED:
                         stop = True
                         break
-                    run.extend(_expand_commit_item(nxt, self.pipe.node))
+                    run.extend(_expand_commit_item(nxt, self.pipe.node,
+                                                   dups))
             if run:
                 self._apply_run(run)
+            for (group, index, query) in dups:
+                # A committed RETRY duplicate: its first copy applied
+                # (this run or earlier), so the retrying client's PUT
+                # succeeded — ack success without re-applying.
+                self._ack_one(group, query, None,
+                              commit_ts=time.monotonic())
             if stop:
                 break
 
@@ -414,9 +432,17 @@ class RaftDB:
                    for g, sm in self._sms.items()}
         self.pipe.node.compact(applied, keep=self._compact_keep)
 
-    def propose(self, query: str, group: int = 0) -> AckFuture:
+    def propose(self, query: str, group: int = 0,
+                token: Optional[int] = None) -> AckFuture:
         """Submit a write; the future resolves after commit + local apply
-        (the reference's blocking-PUT contract, httpapi.go:45-49)."""
+        (the reference's blocking-PUT contract, httpapi.go:45-49).
+
+        `token` (a client retry token, X-Raft-Retry-Token) pins the
+        proposal's envelope id: a client re-sending the same logical
+        PUT — after a timeout, a dropped connection, or a crashed
+        leader — passes the same token and the publish-time dedup
+        window applies whichever copies commit exactly once (the
+        duplicate's commit still ACKS, it just doesn't re-apply)."""
         fut = AckFuture()
         if is_select(query):
             fut.set(ValueError("expected non-SELECT"))
@@ -433,7 +459,7 @@ class RaftDB:
                 fut.set(RuntimeError("db is closed"))
                 return fut
             self._q2cb[(group, query)].append(fut)
-        self.pipe.propose(group, query.encode("utf-8"))
+        self.pipe.propose(group, query.encode("utf-8"), token)
         return fut
 
     def abandon(self, query: str, group: int, fut: AckFuture) -> None:
@@ -557,6 +583,33 @@ class RaftDB:
 
     def render_members(self) -> str:
         return json.dumps(self.members(), sort_keys=True) + "\n"
+
+    # -- readiness (GET /healthz) ---------------------------------------
+
+    def health_doc(self) -> dict:
+        """GET /healthz: node id, per-group role / leader hint / term /
+        commit (from the engine's host-side status caches) plus each
+        group's APPLIED index from the state machines.  Answering at
+        all means the process is up and replay finished (the
+        constructor blocks on replay); the nemesis and operators read
+        role/leader to detect restart completion without a write
+        probe."""
+        node = self.pipe.node
+        status_fn = getattr(node, "status", None)
+        groups = status_fn() if status_fn is not None else {
+            str(g): {"role": "unknown",
+                     "leader": int(node.leader_of(g)) + 1
+                     if hasattr(node, "leader_of") else 0}
+            for g in range(self.num_groups)}
+        for g in range(self.num_groups):
+            row = groups.get(str(g))
+            if row is not None:
+                row["applied"] = int(self._sms[g].applied_index())
+        return {"id": int(getattr(node, "node_id", 0)),
+                "ready": True, "groups": groups}
+
+    def render_health(self) -> str:
+        return json.dumps(self.health_doc(), sort_keys=True) + "\n"
 
     # -- observability exports (raftsql_tpu/obs/) ----------------------
 

@@ -1353,7 +1353,12 @@ class FusedPipe:
         node.publish_peers = {0}
         self.commit_q = node.commit_q(0)
 
-    def propose(self, group: int, payload: bytes) -> None:
+    def propose(self, group: int, payload: bytes,
+                pid: Optional[int] = None) -> None:
+        # `pid` (client retry token) is accepted for facade parity and
+        # dropped: fused proposals are routed on the host and never
+        # forward-retried, so payloads travel PLAIN (no envelope to
+        # carry the token — see runtime/db.py RAW_PLAIN contract).
         self.node.propose_many(group, [payload])
 
     @property

@@ -39,8 +39,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import (FOLLOWER, LEADER, MSG_REQ, MSG_RESP,
-                                NO_VOTE, RaftConfig)
+from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_REQ,
+                                MSG_RESP, NO_VOTE, PRECANDIDATE,
+                                RaftConfig)
 from raftsql_tpu.core.state import (install_snapshot_state,
                                     restore_peer_state, set_group_config,
                                     set_peer_progress)
@@ -67,6 +68,10 @@ log = logging.getLogger("raftsql_tpu.node")
 # Commit-queue sentinel marking end-of-stream (the reference closes the
 # channel; Python queues need an explicit object).
 CLOSED = object()
+
+# Role-code → wire name map for GET /healthz (status()).
+_ROLE_NAMES = {FOLLOWER: "follower", CANDIDATE: "candidate",
+               LEADER: "leader", PRECANDIDATE: "precandidate"}
 
 class _PackedView:
     """Attribute access over columns of a packed numpy array — the
@@ -265,11 +270,16 @@ class RaftNode:
                                  [d for (_, d) in gl.entries],
                                  [t for (t, _) in gl.entries])
             self._hard_np[g] = (gl.hard.term, gl.hard.vote, gl.hard.commit)
-            # Reference parity: replay publishes every WAL entry, then the
-            # nil sentinel (raft.go:130-132); apply-at-commit only governs
-            # live traffic.  Empty (no-op/conf) entries are skipped
-            # (raft.go:84-87).
-            self._applied[g] = gl.log_len
+            # Replay publishes the COMMITTED prefix only (then the nil
+            # sentinel); the appended-but-uncommitted tail re-publishes
+            # through the ordinary commit path once a leader commits it.
+            # The reference publishes the WHOLE replayed log
+            # (raft.go:130-132) — applying entries a new leader may
+            # conflict-truncate: the process-plane chaos harness caught a
+            # restarted node keeping such a phantom row in SQLite forever
+            # (survivors can then never converge;
+            # tests/test_node_loop.py::test_replay_publishes_only_committed_prefix).
+            self._applied[g] = min(gl.log_len, gl.hard.commit)
         self._replay_groups = groups
         self.wal = WAL(data_dir, segment_bytes=cfg.wal_segment_bytes)
         # Dynamic membership (raftsql_tpu/membership/): always on — a
@@ -311,7 +321,11 @@ class RaftNode:
         unless threaded=False (benchmarks/tests that drive `tick()`
         manually for deterministic lockstep) — the tick thread."""
         for g, gl in sorted(self._replay_groups.items()):
-            for i, (term, data) in enumerate(gl.entries):
+            # Committed prefix only — see the _applied restore in
+            # __init__ for why the uncommitted tail must NOT reach the
+            # state machine here.
+            upto = max(0, min(gl.log_len, gl.hard.commit) - gl.start)
+            for i, (term, data) in enumerate(gl.entries[:upto]):
                 sql = self._decode_entry(g, data, gl.start + 1 + i)
                 if sql is not None:
                     self.commit_q.put((g, gl.start + 1 + i, sql))
@@ -367,19 +381,24 @@ class RaftNode:
         if hasattr(self.transport, "obs"):
             self.transport.obs = self.tracer
 
-    def propose(self, group: int, payload: bytes) -> None:
+    def propose(self, group: int, payload: bytes,
+                pid: Optional[int] = None) -> None:
         """Enqueue a proposal; routed to the leader on the next tick.
 
         The payload is wrapped with a unique envelope id so that
         forward-retries after leader failure apply exactly once
-        (runtime/envelope.py)."""
+        (runtime/envelope.py).  `pid` pins the envelope id instead of
+        drawing a fresh one — the CLIENT-retry token (api/client.py
+        X-Raft-Retry-Token): a PUT re-sent across a crash or leader
+        failover re-proposes under the same id, and the publish-time
+        dedup collapses whichever copies commit to one apply."""
         if not 0 <= group < self.cfg.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.cfg.num_groups})")
         if self.tracer is not None:
             self.tracer.begin(group, payload.decode("utf-8", "replace"))
         with self._prop_lock:
-            self._props[group].append(wrap(payload))
+            self._props[group].append(wrap(payload, pid))
             self._prop_len[group] += 1
             self._fwd_groups.add(group)
         self._work_evt.set()
@@ -514,6 +533,21 @@ class RaftNode:
         from a client thread races buffer invalidation ("Array has been
         deleted")."""
         return int(self._last_hint[group])
+
+    def status(self) -> dict:
+        """Per-group consensus status for GET /healthz: role, last known
+        leader (1-based, 0 unknown), term, and commit index.  Reads only
+        the host-side per-tick caches (same client-thread contract as
+        leader_of) — a readiness probe must never touch device state."""
+        roles = self._last_role.tolist()
+        hints = self._last_hint.tolist()
+        hard = self._hard_np
+        return {
+            str(g): {"role": _ROLE_NAMES.get(roles[g], "unknown"),
+                     "leader": hints[g] + 1,
+                     "term": int(hard[g, 0]),
+                     "commit": int(hard[g, 2])}
+            for g in range(self.cfg.num_groups)}
 
     # ------------------------------------------------------------------
     # linearizable reads (ReadIndex, raft §6.4 — beyond the reference's
@@ -1167,9 +1201,11 @@ class RaftNode:
                     self._local[g] = [(ix, d) for (ix, d) in mine
                                       if ix < start]
                 if info.app_conflict[g] and self._applied[g] >= start:
-                    # Only possible for replay-published uncommitted
-                    # entries (the reference applies at append and shares
-                    # this hazard — SURVEY.md §3.2 quirk).
+                    # Should be unreachable since replay stopped
+                    # publishing the uncommitted tail (committed entries
+                    # never conflict-truncate); kept as a loud guard —
+                    # the reference applies at append and has exactly
+                    # this hazard (SURVEY.md §3.2 quirk).
                     log.warning("node %d g%d: conflict truncation below "
                                 "applied=%d; state machine may have seen "
                                 "an uncommitted entry", self.node_id, g,

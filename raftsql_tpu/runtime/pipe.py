@@ -37,8 +37,9 @@ class RaftPipe:
         node.start()
         return pipe
 
-    def propose(self, group: int, payload: bytes) -> None:
-        self.node.propose(group, payload)
+    def propose(self, group: int, payload: bytes,
+                pid: Optional[int] = None) -> None:
+        self.node.propose(group, payload, pid)
 
     @property
     def error(self) -> Optional[Exception]:

@@ -18,7 +18,10 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
+import threading
+import time
 
 
 def _pin_platform_from_env() -> None:
@@ -37,7 +40,7 @@ def _pin_platform_from_env() -> None:
         import jax
         jax.config.update("jax_platforms", want)
 
-from raftsql_tpu.api.http import serve_http_sql_api
+from raftsql_tpu.api.http import SQLServer
 from raftsql_tpu.config import RaftConfig
 from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
 from raftsql_tpu.runtime.db import RaftDB
@@ -124,6 +127,71 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                   compact_every=compact_every, compact_keep=compact_keep)
 
 
+# Exit code when the consensus engine dies of a fatal error (failed
+# fsync, injected ENOSPC, transport teardown): the etcd posture — a
+# server that can no longer participate must CRASH, visibly, rather
+# than keep answering HTTP with a dead engine behind it.  The chaos
+# nemesis (chaos/proc.py) keys on this code.
+EXIT_CODE_FATAL = 70
+
+
+def _install_graceful_shutdown(rdb, srv_stop) -> None:
+    """SIGTERM/SIGINT → clean stop: stop the HTTP plane (threaded or
+    aio — whichever `srv_stop` closes), then close the pipe, which
+    flushes and fsyncs the WAL and closes both the consensus transport
+    and the SQLite state machines (RaftDB.close → RaftPipe.close →
+    RaftNode.stop → WAL.close).  Exit code 0 distinguishes a clean stop
+    from a crash — `kill -TERM` is "stop", SIGKILL is "crash".
+
+    The handler only spawns a worker thread: the main thread is inside
+    serve_forever(), and running a blocking shutdown inside the signal
+    frame would deadlock against it.  A second signal while the first
+    shutdown runs hard-exits (an operator's double Ctrl-C must win)."""
+    fired = threading.Event()
+
+    def _graceful(signum, frame):
+        if fired.is_set():
+            os._exit(0)
+        fired.set()
+
+        def _work():
+            try:
+                srv_stop()
+            except Exception:                       # noqa: BLE001
+                pass
+            try:
+                rdb.close()
+            except Exception:                       # noqa: BLE001
+                pass
+            os._exit(0)
+
+        # Non-daemon: when srv_stop() unblocks serve_forever and main()
+        # returns, interpreter shutdown must WAIT for the WAL flush in
+        # rdb.close() instead of killing it mid-write (the worker ends
+        # the process itself via os._exit).
+        threading.Thread(target=_work, daemon=False,
+                         name="graceful-shutdown").start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+
+def _watch_fatal(rdb) -> None:
+    """Exit the process (EXIT_CODE_FATAL) when the consensus engine
+    records a terminal error — see EXIT_CODE_FATAL above."""
+    def _work():
+        while True:
+            if rdb.pipe.error is not None:
+                logging.getLogger("raftsql.server").error(
+                    "consensus engine failed, exiting: %s",
+                    rdb.pipe.error)
+                os._exit(EXIT_CODE_FATAL)
+            time.sleep(0.2)
+
+    threading.Thread(target=_work, daemon=True,
+                     name="fatal-watch").start()
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="TPU-native replicated SQL")
     ap.add_argument("--cluster", default="http://127.0.0.1:9021",
@@ -167,6 +235,12 @@ def main(argv=None) -> None:
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
+    # Env-injected storage faults (RAFTSQL_FSIO_FAULTS): the chaos
+    # nemesis's seam across the process boundary.  Installed before the
+    # node boots so the very first WAL byte flows through the rules;
+    # a malformed spec must kill the boot, not silently drop faults.
+    from raftsql_tpu.storage import fsio
+    fsio.install_from_env()
     # The serving process is ~30 cooperating threads (tick loop, HTTP
     # handlers, commit consumer, transport); CPython's default 5 ms GIL
     # switch interval makes every cross-thread handoff on the
@@ -197,11 +271,14 @@ def main(argv=None) -> None:
                          compact_keep=args.compact_keep,
                          wal_segment_bytes=args.wal_segment_bytes,
                          trace=args.trace)
+    _watch_fatal(rdb)
     if args.http_engine == "aio":
         from raftsql_tpu.api.aio import AioSQLServer
-        AioSQLServer(args.port, rdb).serve_forever()
+        srv = AioSQLServer(args.port, rdb)
     else:
-        serve_http_sql_api(args.port, rdb)
+        srv = SQLServer(args.port, rdb)
+    _install_graceful_shutdown(rdb, srv.stop)
+    srv.serve_forever()
 
 
 if __name__ == "__main__":

@@ -34,6 +34,26 @@ Fault classes (the chaos harness's storage axis):
     one): data IS durable afterwards, just late — the tick slows, no
     invariant may break, and the stall count is exported so slow-disk
     incidents are visible in /metrics.
+  * PROCESS EXIT AT FSYNC — the Nth fsync matching a rule hard-exits
+    the WHOLE PROCESS (os._exit, EXIT_CODE_FSYNC_CRASH) before the
+    real fsync runs: the process-plane chaos harness's crash point.
+    The written-but-not-yet-synced tail sits in the page cache, the
+    tick's ack never happens, and the restarted process must recover
+    through WAL tail repair — the "machine died at the worst moment"
+    scenario over a REAL server process, not an in-process simulation.
+
+Faults cross the process boundary via RAFTSQL_FSIO_FAULTS: the server
+entry point (server/main.py) parses the env spec with
+`install_from_env` and installs the rules inside the child before the
+node boots, so a nemesis that only controls argv/env can still inject
+disk faults into real server processes.  Spec grammar (';'-separated
+rules, ':'-separated fields, first field is the path substring):
+
+    raftsql-2:enospc@12            ENOSPC on WAL write attempt #12
+    raftsql-2:exit_fsync@9         hard process exit at fsync #9
+    raftsql-1:fail_fsync@5         fsync #5 raises FsyncFaultError
+    raftsql-3:stall@4x3x50         fsyncs #4..#6 stall 50 ms each
+    raftsql-1:enospc@8:stall@2x2x20   clauses compose per rule
 
 The injector also keeps an ordered event log (("write"|"fsync"|
 "fsync_dir", path) tuples) so tests can assert durability ORDERING —
@@ -51,6 +71,7 @@ from __future__ import annotations
 
 import errno
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -58,6 +79,12 @@ from typing import Dict, List, Optional, Tuple
 
 class FsyncFaultError(OSError):
     """Injected fsync failure (distinguishable from real OS errors)."""
+
+
+# Exit code of an injected process-exit-at-fsync crash point: the
+# nemesis (chaos/proc.py) distinguishes "the scheduled disk crash
+# fired" from a real bug in the child by this code.
+EXIT_CODE_FSYNC_CRASH = 86
 
 
 class EnospcError(OSError):
@@ -87,7 +114,7 @@ class _FsyncRule:
 
     def __init__(self, substring: str, fail_at=(), silent_from=None,
                  crash_write_at=(), tag=None, enospc_write_at=(),
-                 stall_at=(), stall_s: float = 0.05):
+                 stall_at=(), stall_s: float = 0.05, exit_at=()):
         self.substring = substring
         self.fail_at = set(fail_at)
         self.silent_from = silent_from
@@ -98,6 +125,9 @@ class _FsyncRule:
         self.enospc_write_at = set(enospc_write_at)
         self.stall_at = set(stall_at)
         self.stall_s = stall_s
+        # Process-exit crash points: fsync op numbers at which the
+        # whole process hard-exits (os._exit, no cleanup).
+        self.exit_at = set(exit_at)
         self.ops = 0
         self.write_ops = 0
         self.failures = 0
@@ -135,10 +165,11 @@ class StorageFaultInjector:
     def add_rule(self, substring: str, fail_at=(),
                  silent_from: Optional[int] = None,
                  crash_write_at=(), tag=None, enospc_write_at=(),
-                 stall_at=(), stall_s: float = 0.05) -> _FsyncRule:
+                 stall_at=(), stall_s: float = 0.05,
+                 exit_at=()) -> _FsyncRule:
         rule = _FsyncRule(substring, fail_at, silent_from,
                           crash_write_at, tag, enospc_write_at,
-                          stall_at, stall_s)
+                          stall_at, stall_s, exit_at)
         with self._lock:
             self.rules.append(rule)
         return rule
@@ -198,6 +229,19 @@ class StorageFaultInjector:
                 if not rule.matches(path):
                     continue
                 rule.ops += 1
+                if rule.ops in rule.exit_at:
+                    # Crash point: the machine dies AT the fsync — the
+                    # record is in the page cache, the barrier never
+                    # completes, nothing after this line runs.  stderr
+                    # is best-effort (the nemesis reads the exit code).
+                    try:
+                        sys.stderr.write(
+                            f"fsio: injected process exit at fsync "
+                            f"{rule.ops} of rule {rule.substring!r} "
+                            f"on {path}\n")
+                        sys.stderr.flush()
+                    finally:
+                        os._exit(EXIT_CODE_FSYNC_CRASH)
                 if rule.ops in rule.fail_at:
                     rule.failures += 1
                     self.fsync_failures += 1
@@ -277,6 +321,67 @@ def active() -> bool:
 
 def injector() -> Optional[StorageFaultInjector]:
     return _injector
+
+
+# -- env-injected faults (the process boundary) ------------------------
+
+def parse_env_spec(spec: str) -> List[dict]:
+    """Parse a RAFTSQL_FSIO_FAULTS value into add_rule kwargs dicts.
+
+    Grammar (module doc): rules ';'-separated, fields ':'-separated,
+    first field the path substring, then `clause@args` clauses with
+    'x'-separated integer args.  Raises ValueError on anything
+    malformed — a server booted with a broken fault spec must fail
+    loudly, not run chaos with silently-dropped faults."""
+    rules = []
+    for rule_s in spec.split(";"):
+        rule_s = rule_s.strip()
+        if not rule_s:
+            continue
+        fields = rule_s.split(":")
+        if len(fields) < 2 or not fields[0]:
+            raise ValueError(f"fsio spec rule needs 'substring:clause', "
+                             f"got {rule_s!r}")
+        kw: dict = {"substring": fields[0]}
+        for clause in fields[1:]:
+            name, at, args_s = clause.partition("@")
+            if at != "@":
+                raise ValueError(f"fsio clause needs 'name@args', "
+                                 f"got {clause!r}")
+            args = [int(a) for a in args_s.split("x")]
+            if name == "enospc" and len(args) == 1:
+                kw.setdefault("enospc_write_at", []).append(args[0])
+            elif name == "fail_fsync" and len(args) == 1:
+                kw.setdefault("fail_at", []).append(args[0])
+            elif name == "exit_fsync" and len(args) == 1:
+                kw.setdefault("exit_at", []).append(args[0])
+            elif name == "stall" and len(args) == 3:
+                k, count, ms = args
+                kw.setdefault("stall_at", []).extend(
+                    range(k, k + count))
+                kw["stall_s"] = ms / 1000.0
+            else:
+                raise ValueError(f"unknown fsio clause {clause!r}")
+        rules.append(kw)
+    return rules
+
+
+def install_from_env(spec: Optional[str] = None) \
+        -> Optional[StorageFaultInjector]:
+    """Install an injector from a RAFTSQL_FSIO_FAULTS-style spec (reads
+    the env var when `spec` is None).  Returns the installed injector,
+    or None when the spec is absent/empty.  This is the server entry
+    point's storage-fault seam: the nemesis sets the env var, the child
+    installs the rules before its first WAL byte."""
+    if spec is None:
+        spec = os.environ.get("RAFTSQL_FSIO_FAULTS", "")
+    rules = parse_env_spec(spec)
+    if not rules:
+        return None
+    inj = StorageFaultInjector()
+    for kw in rules:
+        inj.add_rule(**kw)
+    return install(inj)
 
 
 class installed:

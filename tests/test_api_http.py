@@ -97,6 +97,56 @@ def test_metrics_endpoint(server):
     assert {"ticks", "proposals", "commits", "msgs_sent"} <= set(m)
 
 
+def test_healthz_endpoint(server):
+    """GET /healthz (both planes): id, per-group role / leader hint /
+    term / applied — the readiness probe the process-plane nemesis
+    uses to detect restart completion without a write."""
+    import json
+    import time
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        deadline = time.monotonic() + 15.0
+        while True:
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            doc = json.loads(r.read())
+            assert r.status == 200
+            assert doc["id"] == 1 and doc["ready"] is True
+            assert set(doc["groups"]) == {"0", "1"}
+            row = doc["groups"]["0"]
+            assert {"role", "leader", "term", "commit",
+                    "applied"} <= set(row)
+            if row["role"] == "leader":     # single node self-elects
+                assert row["leader"] == 1 and row["term"] >= 1
+                break
+            assert time.monotonic() < deadline, doc
+            time.sleep(0.05)
+    finally:
+        conn.close()
+
+
+def test_put_retry_token_applies_exactly_once(server):
+    """X-Raft-Retry-Token (both planes): re-sending a PUT with the same
+    token must ACK normally but apply once — the envelope dedup rides
+    the token across client retries, so retry-after-accept is safe
+    (api/client.py's whole premise)."""
+    r, _ = req(server, "PUT", b"CREATE TABLE main.rt (v text)")
+    assert r.status == 204
+    hdr = {"X-Raft-Retry-Token": "00c0ffee00c0ffee"}
+    for _ in range(3):
+        r, data = req(server, "PUT",
+                      b"INSERT INTO main.rt (v) VALUES ('once')",
+                      headers=hdr)
+        assert r.status == 204, (r.status, data)
+    # A DIFFERENT token is a different logical request: applies again.
+    r, _ = req(server, "PUT",
+               b"INSERT INTO main.rt (v) VALUES ('once')",
+               headers={"X-Raft-Retry-Token": "00000000deadbeef"})
+    assert r.status == 204
+    r, data = req(server, "GET", b"SELECT count(*) FROM main.rt")
+    assert r.status == 200 and data == b"|2|\n", data
+
+
 def test_concurrent_puts_all_ack(server):
     """Many keep-alive connections proposing at once: every PUT must
     block until ITS commit+apply and ack 204 (httpapi.go:38-49 under
@@ -185,7 +235,7 @@ def test_put_propose_failure_answers_400(server, monkeypatch):
     during shutdown) must answer 400, not kill the handler and leave
     the connection hanging with busy=True (ADVICE r5 low — the aio
     plane's _do_put previously called propose outside any try)."""
-    def boom(self, query, group=0):
+    def boom(self, query, group=0, token=None):
         raise RuntimeError("injected propose failure")
 
     # Class-level: the threaded plane closes over the RaftDB instance

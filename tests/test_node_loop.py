@@ -3,12 +3,13 @@
 Covers step elision: interval-paced wakeups accumulate timer advance
 without stepping while the device-reported timer_margin says no
 election/heartbeat can fire, and the work event resumes full service
-immediately.
+immediately — plus the replay/publish contract (committed prefix only).
 """
+import queue
 import time
 
-from raftsql_tpu.config import RaftConfig
-from raftsql_tpu.runtime.node import RaftNode
+from raftsql_tpu.config import NO_VOTE, RaftConfig
+from raftsql_tpu.runtime.node import CLOSED, RaftNode
 from raftsql_tpu.transport.loopback import LoopbackHub, LoopbackTransport
 
 
@@ -45,3 +46,60 @@ def test_threaded_node_elides_idle_steps(tmp_path):
             time.sleep(0.01)
     finally:
         n.stop()
+
+
+def test_replay_publishes_only_committed_prefix(tmp_path):
+    """fail-before/pass-after (found by the process-plane chaos seed
+    sweep): a restarted node must NOT publish its appended-but-
+    UNCOMMITTED WAL tail to the state machine — a new leader may
+    conflict-truncate it, and the phantom apply would diverge this
+    replica's SQLite forever (survivors can then never converge).  The
+    replaced entry must instead arrive exactly once through the
+    ordinary commit path."""
+    from raftsql_tpu.runtime.db import _expand_commit_item
+    from raftsql_tpu.storage.wal import WAL
+
+    # Hand-crafted WALs: a shared committed entry at index 1; node 1
+    # additionally appended "lost-write" at index 2 in term 1 but never
+    # committed it, while the term-2 majority (nodes 2, 3) committed a
+    # DIFFERENT entry there.
+    def make_wal(d, tail_term, tail_sql, term, commit):
+        w = WAL(str(d))
+        w.append_entry(0, 1, 1, b"SET shared")
+        w.append_entry(0, 2, tail_term, tail_sql)
+        w.set_hardstate(0, term, NO_VOTE, commit)
+        w.sync()
+        w.close()
+
+    make_wal(tmp_path / "n1", 1, b"SET lost-write", 1, 1)
+    make_wal(tmp_path / "n2", 2, b"SET won-write", 2, 2)
+    make_wal(tmp_path / "n3", 2, b"SET won-write", 2, 2)
+
+    cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=0.002,
+                     log_window=32, max_entries_per_msg=4)
+    hub = LoopbackHub()
+    nodes = [RaftNode(i + 1, 3, cfg, LoopbackTransport(hub),
+                      data_dir=str(tmp_path / f"n{i + 1}"))
+             for i in range(3)]
+    published = []
+    try:
+        for n in nodes:
+            n.start(threaded=True)
+        deadline = time.monotonic() + 15
+        while not any(s == "SET won-write" for (_, _, s) in published):
+            assert time.monotonic() < deadline, published
+            try:
+                item = nodes[0].commit_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None or item is CLOSED:
+                continue
+            published.extend(_expand_commit_item(item, nodes[0]))
+    finally:
+        for n in nodes:
+            n.stop()
+    sqls = [s for (_, _, s) in published]
+    assert "SET lost-write" not in sqls, sqls
+    assert sqls.count("SET won-write") == 1, sqls
+    # The committed prefix itself did replay.
+    assert sqls[0] == "SET shared", sqls

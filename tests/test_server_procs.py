@@ -2,170 +2,74 @@
 
 The reference's proof of life is 3 OS processes wired by real sockets
 (reference Procfile:2-4, raftsql_test.go:16-41).  The in-process cluster
-tests all ride LoopbackTransport; this test boots 3 actual
+tests all ride LoopbackTransport; these tests boot 3 actual
 `raftsql_tpu.server.main` processes on localhost (TcpTransport + HTTP API
-+ WAL + SQLite), drives them with HTTP like the README's curl recipe, then
-crash-restarts one node and requires catch-up.
++ WAL + SQLite) via the chaos harness's ProcCluster, drive them with the
+hardened HTTP client (api/client.py — per-request timeouts, backoff,
+leader caching, retry tokens: the former private `sql`/`put_when_up`/
+`get_retry` helpers, done properly once), then crash-restart a node and
+require catch-up.
 """
-import http.client
-import os
-import signal
-import subprocess
-import sys
-import time
-
 import pytest
 
-from conftest import reserve_ports
+from raftsql_tpu.api.client import RaftSQLClient, SQLError
+from raftsql_tpu.chaos.proc import ProcCluster
 
 TIMEOUT = 90.0
 
 
-def sql(port: int, method: str, body: str, timeout: float = 60.0,
-        group: int | None = None):
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
-    headers = {} if group is None else {"X-Raft-Group": str(group)}
-    try:
-        conn.request(method, "/", body=body.encode(), headers=headers)
-        r = conn.getresponse()
-        return r.status, r.read().decode()
-    finally:
-        conn.close()
+def _boot3(tmp_path, groups: int = 1):
+    c = ProcCluster(str(tmp_path), peers=3, groups=groups, tick=0.02)
+    for i in range(3):
+        c.spawn(i)
+    cli = RaftSQLClient([f"127.0.0.1:{p}" for p in c.http_ports],
+                        timeout_s=10.0)
+    return c, cli
 
 
-def put_when_up(port: int, body: str, deadline: float,
-                group: int | None = None) -> None:
-    """PUT once the node is reachable; a PUT is only retried while the
-    connection is REFUSED (nothing was enqueued), never after the server
-    accepted it — re-sending a slow-but-committed write would duplicate
-    it (writes here are not idempotent, matching the reference's
-    content-keyed ack model, db.go:112-118)."""
-    last = None
-    while time.monotonic() < deadline:
-        try:
-            status, text = sql(port, "PUT", body, group=group)
-            assert status == 204, (status, text)
-            return
-        except ConnectionRefusedError as e:
-            last = e
-            time.sleep(0.25)
-    pytest.fail(f"PUT {body!r} on :{port}: never reachable, last={last}")
-
-
-def get_retry(port: int, body: str, want_body: str,
-              deadline: float, group: int | None = None) -> str:
-    """Idempotent read: retry until the answer matches (replication is
-    async; the reference polls the same way, raftsql_test.go:159-170)."""
-    last = None
-    while time.monotonic() < deadline:
-        try:
-            status, text = sql(port, "GET", body, group=group)
-            last = (status, text)
-            if status == 200 and text == want_body:
-                return text
-        except OSError:
-            last = ("conn", None)
-        time.sleep(0.25)
-    pytest.fail(f"GET {body!r} on :{port}: wanted {want_body!r}, "
-                f"last={last}")
-
-
-class Cluster3:
-    """3 server/main.py subprocesses on free localhost ports."""
-
-    def __init__(self, tmp_path, groups: int = 1):
-        self.tmp = tmp_path
-        self.groups = groups
-        ports, release = reserve_ports(6)  # held until just before Popen
-        self.peer_ports, self.http_ports = ports[:3], ports[3:]
-        self.cluster = ",".join(f"http://127.0.0.1:{p}"
-                                for p in self.peer_ports)
-        self.procs = [None, None, None]
-        self._release_ports = release
-        repo_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))
-        self.env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            PYTHONPATH=repo_root + (
-                os.pathsep + os.environ["PYTHONPATH"]
-                if os.environ.get("PYTHONPATH") else ""))
-        self._release_ports()
-        for i in range(3):
-            self.start(i)
-
-    def start(self, i: int) -> None:
-        logf = open(self.tmp / f"node{i + 1}.log", "ab")
-        self.procs[i] = subprocess.Popen(
-            [sys.executable, "-m", "raftsql_tpu.server.main",
-             "--id", str(i + 1), "--cluster", self.cluster,
-             "--port", str(self.http_ports[i]), "--tick", "0.02",
-             "--groups", str(self.groups)],
-            cwd=self.tmp, env=self.env, stdout=logf, stderr=logf)
-
-    def kill(self, i: int) -> None:
-        p = self.procs[i]
-        if p is not None and p.poll() is None:
-            p.send_signal(signal.SIGKILL)     # crash, not graceful stop
-            p.wait(timeout=10)
-        self.procs[i] = None
-
-    def stop_all(self) -> None:
-        for p in self.procs:
-            if p is not None and p.poll() is None:
-                p.terminate()
-        for p in self.procs:
-            if p is not None:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-
-    def logs(self) -> str:
-        out = []
-        for i in range(3):
-            f = self.tmp / f"node{i + 1}.log"
-            if f.exists():
-                out.append(f"--- node{i + 1} ---\n"
-                           + f.read_text()[-2000:])
-        return "\n".join(out)
+def _logs(c: ProcCluster) -> str:
+    return "\n".join(f"--- node{i + 1} ---\n" + c.log_tail(i, 2000)
+                     for i in range(3))
 
 
 def test_three_process_cluster_put_get_restart(tmp_path):
-    c = Cluster3(tmp_path)
+    c, cli = _boot3(tmp_path)
     try:
-        deadline = time.monotonic() + TIMEOUT
         # README curl recipe: PUT on node 1, INSERT via node 2, read on 3.
-        put_when_up(c.http_ports[0], "CREATE TABLE t (name text)",
-                    deadline)
-        put_when_up(c.http_ports[1], "INSERT INTO t (name) VALUES ('abc')",
-                    deadline)
-        get_retry(c.http_ports[2], "SELECT name FROM t", "|abc|\n",
-                  deadline)
+        cli.put("CREATE TABLE t (name text)", node=0,
+                deadline_s=TIMEOUT)
+        cli.put("INSERT INTO t (name) VALUES ('abc')", node=1,
+                deadline_s=TIMEOUT)
+        cli.get_until("SELECT name FROM t", "|abc|\n", node=2,
+                      deadline_s=TIMEOUT)
         # Method semantics over the real stack: 405 + Allow header.
-        status, _ = sql(c.http_ports[0], "POST", "x")
+        status, _, _ = cli.raw(0, "POST", "/", "x")
         assert status == 405
         # Bad SQL propagates the apply error as 400 (reference
-        # httpapi.go:45-49 blocking-PUT contract).
-        status, _ = sql(c.http_ports[0], "PUT", "INSERT INTO nosuch "
-                        "VALUES (1)")
-        assert status == 400
+        # httpapi.go:45-49 blocking-PUT contract) — the client must NOT
+        # retry a deterministic failure.
+        with pytest.raises(SQLError):
+            cli.put("INSERT INTO nosuch VALUES (1)", node=0,
+                    deadline_s=TIMEOUT)
 
         # Crash node 2 (SIGKILL), write while it is down, restart it, and
         # require the missed write to stream in from the leader
         # (reference raftsql_test.go:117-170).
-        c.kill(1)
-        deadline = time.monotonic() + TIMEOUT
-        put_when_up(c.http_ports[0],
-                    "INSERT INTO t (name) VALUES ('while-down')", deadline)
-        c.start(1)
-        deadline = time.monotonic() + TIMEOUT
+        c.sigkill(1)
+        cli.put("INSERT INTO t (name) VALUES ('while-down')", node=0,
+                deadline_s=TIMEOUT)
+        c.spawn(1)
         try:
-            get_retry(c.http_ports[1], "SELECT count(*) FROM t", "|2|\n",
-                      deadline)
+            cli.get_until("SELECT count(*) FROM t", "|2|\n", node=1,
+                          deadline_s=TIMEOUT)
         except BaseException:
-            print(c.logs())
+            print(_logs(c))
             raise
+        # Clean stop is SIGTERM (graceful-shutdown handler): the WAL is
+        # flushed and every process exits 0 — SIGKILL above was "crash",
+        # this is "stop".
+        codes = c.stop_all()
+        assert codes == [0, 0, 0], (codes, _logs(c))
     finally:
         c.stop_all()
 
@@ -176,50 +80,42 @@ def test_multi_group_over_real_processes(tmp_path):
     distinct groups via different nodes, per-group isolation (each group
     is its own SQLite database), and group state surviving a SIGKILL
     crash/restart — VERDICT r2 task 7."""
-    c = Cluster3(tmp_path, groups=4)
+    c, cli = _boot3(tmp_path, groups=4)
     try:
-        deadline = time.monotonic() + TIMEOUT
         # One table per group, created via a different node each time;
         # rows encode the group id.
         for g in range(4):
             node = g % 3
-            put_when_up(c.http_ports[node], "CREATE TABLE t (v text)",
-                        deadline, group=g)
-            put_when_up(c.http_ports[node],
-                        f"INSERT INTO t (v) VALUES ('g{g}')",
-                        deadline, group=g)
+            cli.put("CREATE TABLE t (v text)", group=g, node=node,
+                    deadline_s=TIMEOUT)
+            cli.put(f"INSERT INTO t (v) VALUES ('g{g}')", group=g,
+                    node=node, deadline_s=TIMEOUT)
         # Every node serves every group; each group sees ONLY its row.
         for g in range(4):
             for node in range(3):
-                get_retry(c.http_ports[node], "SELECT v FROM t",
-                          f"|g{g}|\n", deadline, group=g)
+                cli.get_until("SELECT v FROM t", f"|g{g}|\n", group=g,
+                              node=node, deadline_s=TIMEOUT)
         # Unknown group -> 400, not a crash.
-        status, _ = sql(c.http_ports[0], "GET", "SELECT v FROM t",
-                        group=99)
+        status, _, _ = cli.raw(0, "GET", "/", "SELECT v FROM t",
+                               headers={"X-Raft-Group": "99"})
         assert status == 400
 
         # Crash node 3; write to two different groups while it is down;
         # restart; both groups' missed writes must stream in, and the
         # untouched groups must stay isolated.
-        c.kill(2)
-        deadline = time.monotonic() + TIMEOUT
-        put_when_up(c.http_ports[0],
-                    "INSERT INTO t (v) VALUES ('late1')", deadline, group=1)
-        put_when_up(c.http_ports[1],
-                    "INSERT INTO t (v) VALUES ('late3')", deadline, group=3)
-        c.start(2)
-        deadline = time.monotonic() + TIMEOUT
+        c.sigkill(2)
+        cli.put("INSERT INTO t (v) VALUES ('late1')", group=1, node=0,
+                deadline_s=TIMEOUT)
+        cli.put("INSERT INTO t (v) VALUES ('late3')", group=3, node=1,
+                deadline_s=TIMEOUT)
+        c.spawn(2)
         try:
-            get_retry(c.http_ports[2], "SELECT count(*) FROM t", "|2|\n",
-                      deadline, group=1)
-            get_retry(c.http_ports[2], "SELECT count(*) FROM t", "|2|\n",
-                      deadline, group=3)
-            get_retry(c.http_ports[2], "SELECT count(*) FROM t", "|1|\n",
-                      deadline, group=0)
-            get_retry(c.http_ports[2], "SELECT count(*) FROM t", "|1|\n",
-                      deadline, group=2)
+            for g, want in ((1, "|2|\n"), (3, "|2|\n"),
+                            (0, "|1|\n"), (2, "|1|\n")):
+                cli.get_until("SELECT count(*) FROM t", want, group=g,
+                              node=2, deadline_s=TIMEOUT)
         except BaseException:
-            print(c.logs())
+            print(_logs(c))
             raise
     finally:
         c.stop_all()
