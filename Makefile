@@ -5,7 +5,12 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native test vet bench chaos chaos-membership chaos-procs \
-	trace clean
+	chaos-mesh trace clean
+
+# The mesh families and tests need a multi-device platform; 8 virtual
+# CPU devices is the no-hardware testing recipe (tests/conftest.py).
+MESH_ENV = JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 # "Build" = compile the native C++ components (storage fast path).
 all: native
@@ -45,8 +50,18 @@ chaos:
 # must pass every invariant.  See README "Chaos fault matrix".
 #   make chaos-matrix SEED=17
 chaos-matrix:
-	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	$(MESH_ENV) $(PY) -m raftsql_tpu.chaos.run \
 	  --matrix --seed $(SEED)
+
+# Mesh-skew chaos (runtime/mesh.py MeshClusterNode): the fused skew
+# family's schedule on the MESH runtime — per-peer clock drift through
+# the shard_map'd step's sharded timer vector, a crash + replay from
+# the per-shard WAL dirs, run twice and digest-compared.  Closes the
+# old MeshLockstepOnlyError frontier.
+#   make chaos-mesh SEED=17
+chaos-mesh:
+	$(MESH_ENV) $(PY) -m raftsql_tpu.chaos.run \
+	  --family mesh_skew --seed $(SEED)
 
 # Membership-churn chaos (raftsql_tpu/membership/): SIGKILL a voter,
 # boot a fresh spare, add-learner -> promote (joint consensus) ->

@@ -440,9 +440,11 @@ def bench_commit_scan(groups: int, repeats: int) -> float:
     return best
 
 
-def bench_multichip(ticks: int, repeats: int) -> float:
+def bench_multichip(ticks: int, repeats: int,
+                    groups: int | None = None) -> float:
     """BASELINE config 5: groups sharded over the device mesh, peer
-    message exchange riding `all_to_all` (parallel/sharded.py)."""
+    message exchange riding `all_to_all` (parallel/sharded.py).
+    `groups` overrides the shape for the G-scale ladder rungs."""
     import jax
     import jax.numpy as jnp
 
@@ -456,7 +458,8 @@ def bench_multichip(ticks: int, repeats: int) -> float:
     n = len(jax.devices())
     pp = 2 if n % 2 == 0 and n > 1 else 1
     gg = n // pp
-    groups = int(os.environ.get("BENCH_GROUPS", 8192 * gg))
+    if groups is None:
+        groups = int(os.environ.get("BENCH_GROUPS", 8192 * gg))
     groups -= groups % gg
     cfg = RaftConfig(num_groups=groups, num_peers=2 * pp if pp > 1 else 3,
                      log_window=64, max_entries_per_msg=8,
@@ -935,7 +938,8 @@ def bench_http(groups: int, seconds: float, clients: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
+def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int,
+                        runtime: str = "fused"):
     """The durable path on the FUSED runtime (runtime/fused.py): all P
     peers advance in ONE device program per tick, per-peer WAL fsync is
     the inter-dispatch barrier (save-before-send), KV apply off peer 0's
@@ -946,6 +950,13 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
     dispatch-bound (~70 ms/exec); the fused runtime pays one dispatch
     per CLUSTER per tick, so durable throughput scales with G x E per
     dispatch instead of drowning in per-peer overhead.
+
+    runtime="mesh" runs the SAME bench on the MESH runtime
+    (runtime/mesh.py MeshClusterNode): the device step shard_map'd with
+    G sharded over the widest groups-only mesh the visible devices
+    allow, per-shard WAL dirs and per-shard publish workers — the
+    multi-chip G-scale durable rung (groups is rounded down to a
+    multiple of the shard count).
     """
     import shutil
     import tempfile
@@ -958,10 +969,17 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
     from raftsql_tpu.runtime.fused import FusedClusterNode
 
     E = int(os.environ.get("BENCH_E", "8"))
+    mesh_cfg = None
+    if runtime == "mesh":
+        from raftsql_tpu.runtime.mesh import MeshConfig
+        import jax as _jax
+        gg = min(len(_jax.devices()), groups)
+        groups -= groups % gg           # divisibility for the mesh
+        mesh_cfg = MeshConfig(group_shards=gg)
     cfg = RaftConfig(num_groups=groups, num_peers=peers,
                      log_window=max(64, 4 * E),
                      max_entries_per_msg=E, tick_interval_s=0.0)
-    tmp = tempfile.mkdtemp(prefix="bench-fused-")
+    tmp = tempfile.mkdtemp(prefix=f"bench-{runtime}-")
     # BENCH_SM=sqlite: the reference-parity apply engine (one SQLite
     # database per group, group-committed transactions) — the FULL
     # product stack on the fused runtime.  Default: the C++ KV plane
@@ -975,8 +993,14 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         raise RuntimeError(
             "BENCH_DURABLE_APPLY=native conflicts with BENCH_SM=sqlite "
             "(the native plane is the KV apply engine)")
+    # The mesh runtime publishes from one worker PER GROUP SHARD; the
+    # in-process C KV apply is a single-consumer design, so the mesh
+    # rung defaults to the queue-drain apply path (opt back in with
+    # BENCH_DURABLE_APPLY=native once the C plane is audited for
+    # concurrent disjoint-group applies).
     native_apply = (apply_req != "python"
-                    and os.environ.get("BENCH_SM") != "sqlite")
+                    and os.environ.get("BENCH_SM") != "sqlite"
+                    and (runtime != "mesh" or apply_req == "native"))
     if native_apply:
         os.environ["RAFTSQL_FUSED_NATIVE_PLOG"] = "1"
     sm_kind = ("sqlite" if os.environ.get("BENCH_SM") == "sqlite"
@@ -1037,7 +1061,15 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
                     lats.append(now - fifo.popleft())
         return cnt
 
-    node = FusedClusterNode(cfg, tmp)
+    if mesh_cfg is not None:
+        from raftsql_tpu.runtime.mesh import MeshClusterNode
+        mesh = mesh_cfg.build()
+        _log(f"  mesh durable: 1x{mesh_cfg.group_shards} devices, "
+             f"{groups} groups ({groups // mesh_cfg.group_shards} per "
+             f"shard), per-shard WAL dirs + publish workers")
+        node = MeshClusterNode(cfg, tmp, mesh)
+    else:
+        node = FusedClusterNode(cfg, tmp)
     node.publish_peers = {0}       # the drain consumes peer 0's stream
     kv_native = None
     if native_apply and not hasattr(node.plogs[0], "handle"):
@@ -1183,13 +1215,17 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int):
         overlapped = node._host_parallel
         tick_ms = sum(v for k, v in phase.items()
                       if not (overlapped and k == "publish"))
-        return best, {"durable_mode": "fused", "durable_sm": sm_kind,
-                      "durable_steps": node._steps,
-                      "durable_phase_ms": phase,
-                      "durable_phase_overlap": overlapped,
-                      "durable_tick_ms": round(tick_ms, 3),
-                      "durable_lat": lat_stats,
-                      "repeat_rates": repeat_rates}
+        out = {"durable_mode": runtime, "durable_sm": sm_kind,
+               "durable_steps": node._steps,
+               "durable_phase_ms": phase,
+               "durable_phase_overlap": overlapped,
+               "durable_tick_ms": round(tick_ms, 3),
+               "durable_lat": lat_stats,
+               "repeat_rates": repeat_rates}
+        if mesh_cfg is not None:
+            out["mesh_group_shards"] = mesh_cfg.group_shards
+            out["mesh_groups"] = groups
+        return best, out
     finally:
         node.stop()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -1277,7 +1313,30 @@ def run_config(config: str, cpu: bool):
     if config == "commit_scan":
         return bench_commit_scan(groups, repeats), {}
     if config == "multichip":
-        return bench_multichip(ticks, repeats), {}
+        # Multi-chip G-scale ladder (MULTICHIP-style JSON): sweep total
+        # group counts over the mesh, smallest first, and headline the
+        # best rung — how far the pod takes G past the one-chip shape.
+        import jax as _jax
+        gg = max(1, len(_jax.devices()) // (
+            2 if len(_jax.devices()) % 2 == 0
+            and len(_jax.devices()) > 1 else 1))
+        default = ",".join(str(g * gg) for g in (1024, 8192, 32768))
+        rungs = [int(x) for x in os.environ.get(
+            "BENCH_MESH_LADDER", default).split(",") if x]
+        ladder: dict = {}
+        best = 0.0
+        for g in rungs:
+            _log(f"== multichip rung G={g} ==")
+            try:
+                r = bench_multichip(ticks, repeats, groups=g)
+                ladder[str(g)] = round(r, 1)
+                best = max(best, r)
+            except Exception as e:                  # noqa: BLE001
+                _log(f"  multichip G={g} FAILED: "
+                     f"{type(e).__name__}: {e}")
+                ladder[str(g)] = f"fault: {type(e).__name__}"
+        return best, {"mesh_ladder": ladder,
+                      "mesh_devices": len(_jax.devices())}
     if config == "rules":
         out = bench_rules_race(groups, peers, ticks, repeats)
         vals = [v for row in out.values() for v in row.values()
@@ -1342,6 +1401,11 @@ def run_config(config: str, cpu: bool):
         # cpu (keeps the historical CPU rung comparable).
         mode = os.environ.get("BENCH_DURABLE_MODE",
                               "node" if cpu else "fused")
+        if mode == "mesh":
+            # The multi-chip durable rung: MeshClusterNode over the
+            # widest groups-only mesh, per-shard WAL + publish workers.
+            return bench_durable_fused(dg, peers, dticks,
+                                       min(repeats, 2), runtime="mesh")
         if mode == "fused":
             return bench_durable_fused(dg, peers, dticks,
                                        min(repeats, 2))

@@ -18,6 +18,10 @@ matrix (ROADMAP open items → chaos/schedule.py generators):
 
     asym             one-directional partitions        (fused plane)
     skew             per-peer clock skew               (fused plane)
+    mesh_skew        per-peer clock skew on the MESH runtime
+                     (groups-sharded shard_map step + per-shard WALs;
+                     needs a multi-device platform — the Makefile
+                     targets force 8 virtual CPU devices)
     corrupt          wire-frame corruption             (lockstep wire plane)
     enospc           disk-full on WAL append           (fused plane)
     fsync_stall      slow-disk fsync latency           (fused plane)
@@ -61,6 +65,12 @@ def _run_fused(sched, steps: int = 1) -> dict:
         return FusedChaosRunner(sched, d, steps=steps).run()
 
 
+def _run_mesh(sched) -> dict:
+    from raftsql_tpu.chaos.scenarios import MeshChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return MeshChaosRunner(sched, d).run()
+
+
 def _check(ok: bool, msg: str) -> bool:
     if not ok:
         print(f"CHAOS FAIL: {msg}", file=sys.stderr)
@@ -85,6 +95,9 @@ def _family_specs():
                  lambda r: r["asym_partitions"] >= 2),
         "skew": (lambda seed: _run_fused(S.generate_skew(seed)), True,
                  lambda r: r["skew_ticks"] > 0),
+        "mesh_skew": (lambda seed: _run_mesh(S.generate_skew(seed)),
+                      True, lambda r: r["skew_ticks"] > 0
+                      and r["crashes"] >= 1),
         "corrupt": (lambda seed: node_run(NodeClusterChaosRunner,
                                           S.generate_corrupt_plan(seed)),
                     True, lambda r: r["corrupt_frames"] > 0),

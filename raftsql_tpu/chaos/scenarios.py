@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from raftsql_tpu.chaos.invariants import (CommitMonotonic,
@@ -96,19 +97,24 @@ def _redirect_to_devnull(files) -> None:
 
 
 def hard_crash_fused(node: FusedClusterNode) -> None:
-    """Simulate a process kill of the whole fused cluster process.
+    """Simulate a process kill of the whole fused/mesh cluster process.
 
     Requires the Python WAL backend (an installed fsio injector forces
     it): the native backend buffers inside C++ where this simulation
-    cannot reach."""
-    _redirect_to_devnull([getattr(w, "_f", None) for w in node.wals]
-                         + [node._epoch_f])
-    # Unblock the publisher worker so the abandoned daemon thread exits
-    # instead of leaking one thread per simulated crash.
-    try:
-        node._pub_q.put_nowait(None)
-    except queue.Full:                   # pragma: no cover - bounded lag
-        pass
+    cannot reach.  A mesh node's per-shard WALs (runtime/mesh.py
+    ShardedWAL) expand to their per-shard file handles."""
+    files = []
+    for w in node.wals:
+        for s in getattr(w, "shards", (w,)):
+            files.append(getattr(s, "_f", None))
+    _redirect_to_devnull(files + [node._epoch_f])
+    # Unblock the publish workers so the abandoned daemon threads exit
+    # instead of leaking threads per simulated crash.
+    for q in node._pub_qs:
+        try:
+            q.put_nowait(None)
+        except queue.Full:               # pragma: no cover - bounded lag
+            pass
 
 
 def hard_crash_node(node: RaftNode) -> None:
@@ -210,9 +216,15 @@ class FusedChaosRunner:
 
     # -- lifecycle -----------------------------------------------------
 
-    def _boot(self, first: bool) -> FusedClusterNode:
-        node = FusedClusterNode(self.cfg, self.data_dir,
+    def _make_node(self) -> FusedClusterNode:
+        """Construct the engine under test; MeshChaosRunner overrides
+        this with the mesh runtime (same host plane, sharded device
+        step + sharded WAL dirs)."""
+        return FusedClusterNode(self.cfg, self.data_dir,
                                 seed=self.sched.seed)
+
+    def _boot(self, first: bool) -> FusedClusterNode:
+        node = self._make_node()
         if self.steps > 1:
             node._steps = self.steps
         node.publish_peers = {0}
@@ -518,6 +530,41 @@ class FusedChaosRunner:
             "safety_observations": self.safety.observations,
             **self.report,
         }
+
+
+class MeshChaosRunner(FusedChaosRunner):
+    """FusedChaosRunner over the MESH runtime (runtime/mesh.py): the
+    same seeded schedules, workload, invariants and durability audit,
+    with the device step shard_map'd over a groups-sharded mesh and the
+    host plane's WALs split per group shard.  Exercises the mesh-skew
+    frontier the old `MeshLockstepOnlyError` used to fence off: chaos
+    SkewWindow schedules drive the sharded step's per-peer timer
+    vector, and crash/restart replays from the per-shard WAL dirs.
+
+    Deterministic like the fused runner: the mesh is pure SPMD math
+    (sharding is an execution detail, never a semantics change — see
+    tests/test_parallel.py), so schedule + result digests must
+    reproduce across runs and MATCH the fused runner's for the same
+    schedule."""
+
+    def __init__(self, schedule: ChaosSchedule, data_dir: str,
+                 cfg: Optional[RaftConfig] = None, steps: int = 1):
+        super().__init__(schedule, data_dir, cfg=cfg, steps=steps)
+        from raftsql_tpu.runtime.mesh import MeshConfig
+        self.mesh_config = MeshConfig.for_groups(self.cfg)
+        if self.mesh_config.group_shards < 2:
+            raise RuntimeError(
+                f"mesh chaos needs >= 2 group shards, have "
+                f"{len(jax.devices())} devices for "
+                f"{self.cfg.num_groups} groups — force a multi-device "
+                "CPU platform with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        self.mesh = self.mesh_config.build()
+
+    def _make_node(self):
+        from raftsql_tpu.runtime.mesh import MeshClusterNode
+        return MeshClusterNode(self.cfg, self.data_dir, self.mesh,
+                               seed=self.sched.seed)
 
 
 def schedule_peers(schedule: ChaosSchedule) -> int:

@@ -3,9 +3,11 @@
 from raftsql_tpu.parallel.sharded import (GROUPS_AXIS, PEERS_AXIS, make_mesh,
                                           make_sharded_cluster_run,
                                           make_sharded_cluster_step,
-                                          shard_cluster_arrays)
+                                          make_sharded_cluster_step_host,
+                                          shard_cluster_arrays, timer_spec)
 
 __all__ = [
     "GROUPS_AXIS", "PEERS_AXIS", "make_mesh", "make_sharded_cluster_run",
-    "make_sharded_cluster_step", "shard_cluster_arrays",
+    "make_sharded_cluster_step", "make_sharded_cluster_step_host",
+    "shard_cluster_arrays", "timer_spec",
 ]

@@ -33,22 +33,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases; this
+# container pins an older jax, so resolve whichever spelling exists.
+try:
+    _shard_map = jax.shard_map
+except AttributeError:                              # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from raftsql_tpu.config import RaftConfig
 from raftsql_tpu.core.state import I32, Inbox, PeerState, StepInfo
 from raftsql_tpu.core.step import peer_step
 
 PEERS_AXIS = "peers"
 GROUPS_AXIS = "groups"
-
-
-class MeshLockstepOnlyError(NotImplementedError):
-    """The mesh (shard_map) runtime ticks every peer in LOCKSTEP: the
-    sharded step has no per-peer timer_inc plumbing, so per-peer clock
-    skew (chaos SkewWindow schedules, or any per-peer pacing) cannot be
-    expressed on it.  Run skew scenarios on the single-chip fused
-    runtime (runtime/fused.py FusedClusterNode, whose cluster_step takes
-    a [P] timer_inc), or extend make_sharded_step_fn to shard a [P]
-    timer vector alongside prop_n."""
 
 
 def make_mesh(n_peer_shards: int, n_group_shards: int,
@@ -140,7 +137,11 @@ def make_sharded_step_fn(cfg: RaftConfig, mesh: Mesh):
 
     Validates divisibility, derives the per-shard config, and returns a
     function over LOCAL blocks: states [p_loc, G_loc, ...], inboxes
-    [p_loc, G_loc, P, ...], prop_n [p_loc, G_loc].
+    [p_loc, G_loc, P, ...], prop_n [p_loc, G_loc], timer_inc [p_loc]
+    (this peer block's slice of the global [P] per-peer timer advance —
+    the same skew seam core/cluster.py cluster_step exposes, so chaos
+    SkewWindow schedules and per-peer pacing express identically on the
+    mesh).
     """
     pp = mesh.shape[PEERS_AXIS]
     gg = mesh.shape[GROUPS_AXIS]
@@ -153,14 +154,15 @@ def make_sharded_step_fn(cfg: RaftConfig, mesh: Mesh):
     local_cfg = dataclasses.replace(cfg, num_groups=cfg.num_groups // gg)
     p_loc = cfg.num_peers // pp
 
-    def _step(states: PeerState, inboxes: Inbox, prop_n: jax.Array):
+    def _step(states: PeerState, inboxes: Inbox, prop_n: jax.Array,
+              timer_inc: jax.Array):
         pidx = jax.lax.axis_index(PEERS_AXIS)
         self_ids = (pidx * p_loc + jnp.arange(p_loc, dtype=I32)).astype(I32)
         goff = jax.lax.axis_index(GROUPS_AXIS) * local_cfg.num_groups
         new_states, outboxes, infos = jax.vmap(
-            lambda st, ib, pn, sid: peer_step(
-                local_cfg, st, ib, pn, sid, goff))(
-                    states, inboxes, prop_n, self_ids)
+            lambda st, ib, pn, sid, ti: peer_step(
+                local_cfg, st, ib, pn, sid, goff, timer_inc=ti))(
+                    states, inboxes, prop_n, self_ids, timer_inc)
         delivered = jax.tree.map(lambda x: _route(x, pp), outboxes)
         # timer_margin is a per-(peer, group-shard) min; the host wants
         # the per-peer min over ALL groups, so reduce it over the group
@@ -170,18 +172,32 @@ def make_sharded_step_fn(cfg: RaftConfig, mesh: Mesh):
             infos.timer_margin, GROUPS_AXIS))
         return new_states, delivered, infos
 
+    _step.p_loc = p_loc
     return _step
 
 
+def timer_spec() -> P:
+    """PartitionSpec of the [P] per-peer timer advance vector: sharded
+    with the owner-peer axis, replicated over groups."""
+    return P(PEERS_AXIS)
+
+
 def make_sharded_cluster_step(cfg: RaftConfig, mesh: Mesh):
-    """Compile one whole-cluster tick SPMD over `mesh`.
+    """Compile one whole-cluster LOCKSTEP tick SPMD over `mesh`.
 
     Returns jitted fn(states, inboxes, prop_n) -> (states, inboxes, infos)
-    with every leaf sharded per {state,inbox,info}_specs.
+    with every leaf sharded per {state,inbox,info}_specs.  Timers
+    advance 1 per peer per tick; the durable mesh runtime uses
+    `make_sharded_cluster_step_host`, which takes the per-peer vector.
     """
     step = make_sharded_step_fn(cfg, mesh)
-    mapped = jax.shard_map(
-        step, mesh=mesh,
+
+    def _lockstep(states, inboxes, prop_n):
+        return step(states, inboxes, prop_n,
+                    jnp.ones((step.p_loc,), I32))
+
+    mapped = _shard_map(
+        _lockstep, mesh=mesh,
         in_specs=(state_specs(), inbox_specs(), _spec2()),
         out_specs=(state_specs(), inbox_specs(), info_specs()))
     return jax.jit(mapped, donate_argnums=(0, 1))
@@ -189,25 +205,40 @@ def make_sharded_cluster_step(cfg: RaftConfig, mesh: Mesh):
 
 def make_sharded_cluster_step_host(cfg: RaftConfig, mesh: Mesh):
     """The sharded tick with single-array host info, for the durable
-    mesh runtime (runtime/fused.py MeshClusterNode): same SPMD program
+    mesh runtime (runtime/mesh.py MeshClusterNode): same SPMD program
     as `make_sharded_cluster_step`, but StepInfo crosses the host
     boundary as ONE packed [P, G, INFO_NCOLS] i32 array (core/step.py
     pack_info) — the host plane (WAL, payload mirroring, publish)
     consumes identical columns whether the cluster runs fused on one
-    chip or sharded over the mesh."""
+    chip or sharded over the mesh.
+
+    Returns jitted fn(states, inboxes, prop_n, timer_inc[P]) ->
+    (states, inboxes, packed_info, busy).  `timer_inc` is the per-peer
+    timer advance (pass ones for lockstep); `busy` is the replicated
+    scalar device-activity bit the fused runtime's idle parking keys on
+    (core/cluster.py cluster_step_host): vote traffic, entry-carrying
+    appends, or rejected append responses anywhere on the mesh."""
+    from raftsql_tpu.config import MSG_REQ, MSG_RESP
     from raftsql_tpu.core.step import pack_info
 
     step = make_sharded_step_fn(cfg, mesh)
 
-    def _step(states, inboxes, prop_n):
-        states, delivered, infos = step(states, inboxes, prop_n)
-        return states, delivered, jax.vmap(pack_info)(infos)
+    def _step(states, inboxes, prop_n, timer_inc):
+        states, ib, infos = step(states, inboxes, prop_n, timer_inc)
+        busy = (jnp.any(ib.v_type != 0)
+                | jnp.any((ib.a_type == MSG_REQ) & (ib.a_n > 0))
+                | jnp.any((ib.a_type == MSG_RESP) & ~ib.a_success))
+        # OR across every mesh shard: replicated scalar (out_spec P()).
+        busy = jax.lax.pmax(
+            jax.lax.pmax(busy.astype(I32), PEERS_AXIS),
+            GROUPS_AXIS) > 0
+        return states, ib, jax.vmap(pack_info)(infos), busy
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         _step, mesh=mesh,
-        in_specs=(state_specs(), inbox_specs(), _spec2()),
+        in_specs=(state_specs(), inbox_specs(), _spec2(), timer_spec()),
         out_specs=(state_specs(), inbox_specs(),
-                   P(PEERS_AXIS, GROUPS_AXIS, None)))
+                   P(PEERS_AXIS, GROUPS_AXIS, None), P()))
     return jax.jit(mapped, donate_argnums=(0, 1))
 
 
@@ -224,6 +255,8 @@ def make_sharded_cluster_run(cfg: RaftConfig, mesh: Mesh, num_ticks: int):
     step = make_sharded_step_fn(cfg, mesh)
 
     def _run(states, inboxes, prop_n):
+        ones = jnp.ones((step.p_loc,), I32)
+
         def group_commit(commit):   # [p_loc, G_loc] -> replicated-[G_loc]
             return jax.lax.pmax(jnp.max(commit, axis=0), PEERS_AXIS)
 
@@ -231,7 +264,7 @@ def make_sharded_cluster_run(cfg: RaftConfig, mesh: Mesh, num_ticks: int):
 
         def body(carry, prop_t):
             st, ib = carry
-            st, ib, _ = step(st, ib, prop_t)
+            st, ib, _ = step(st, ib, prop_t, ones)
             return (st, ib), None
 
         (states, inboxes), _ = jax.lax.scan(
@@ -241,7 +274,7 @@ def make_sharded_cluster_run(cfg: RaftConfig, mesh: Mesh, num_ticks: int):
         return states, inboxes, total
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             _run, mesh=mesh,
             in_specs=(state_specs(), inbox_specs(),
                       P(None, PEERS_AXIS, GROUPS_AXIS)),

@@ -1,0 +1,1391 @@
+"""ClusterHostPlane — the durable host phase shared by every
+single-controller runtime.
+
+runtime/fused.py (one chip) and runtime/mesh.py (a device mesh) run the
+same per-tick contract (reference raft.go:227-235: wal.Save →
+transport.Send → publish, with the dispatch itself as the send barrier):
+
+  messages composed at tick t are OBSERVED by their receivers only
+  inside step t+1 — and the host does not dispatch step t+1 until every
+  peer's tick-t appends and hard states are fsynced.
+
+This module is the host half of that contract, factored out of the
+original ~1400-line runtime/fused.py so both runtimes share ONE codepath
+for propose queues and leader routing, WAL + payload-log writes, the
+per-peer fsync barrier, epoch-framed multi-step dispatch, commit
+publish, and membership apply-at-commit.  The device half — how one
+tick's consensus math is dispatched — is the single abstract method
+`_device_step`, implemented by:
+
+  * FusedClusterNode (runtime/fused.py): core/cluster.py
+    cluster_step_host / cluster_multistep_host on one device;
+  * MeshClusterNode (runtime/mesh.py): the shard_map'd SPMD step
+    (parallel/sharded.py) over a `Mesh`, G sharded over a `groups`
+    axis and the peer exchange riding all_to_all.
+
+Subclass seams (all default to the single-device layout):
+
+  _new_wal / _wal_exists / _wal_replay / _wal_repair_epochs — how a
+    peer's durable log is laid out on disk.  The mesh runtime shards
+    each peer's WAL per group shard (runtime/mesh.py ShardedWAL) so the
+    durable plane gets one directory — and one fsync stream — per local
+    device shard.
+  _pub_shard_count / _pub_shard_groups — how many ordered publish
+    workers drain commits to the apply plane and which group block each
+    owns.  The fused runtime keeps the single FIFO worker; the mesh
+    runtime runs one worker per group shard (disjoint groups, so
+    per-group commit order is preserved without any cross-worker
+    coordination).
+
+Payload plane: entry BYTES never touch the device (the step moves
+counts, terms and indexes).  Each peer owns a host PayloadLog + WAL;
+a follower that accepts entries mirrors the bytes from the SOURCE
+peer's payload log.  Within one host phase all mirror READS happen
+before any payload-log WRITES: the reads then see exactly the
+end-of-previous-tick state the device composed those appends from, so
+a same-tick truncation on the source cannot tear a mirror.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.core.cluster import (empty_cluster_inbox,
+                                      init_cluster_state)
+from raftsql_tpu.core.state import (restore_peer_state,
+                                    set_group_config_stacked)
+from raftsql_tpu.core.step import INFO_FIELDS
+from raftsql_tpu.transport.codec import (CONF_PREFIX as _CONF_PREFIX,
+                                         decode_conf_entry,
+                                         is_conf_entry)
+from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
+from raftsql_tpu.native.build import load_native_plog
+from raftsql_tpu.storage import fsio
+from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
+from raftsql_tpu.storage.wal import (WAL, split_uniform_runs,
+                                     wal_exists, wal_mirror_all)
+from raftsql_tpu.utils.metrics import NodeMetrics
+
+_C = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+
+def _read_committed_epoch(path: str) -> int:
+    """Last valid (u64 no, u32 crc) record of the epoch-commit file; 0
+    when missing/empty.  A torn trailing record (crash mid-append)
+    falls back to the previous one — the dispatch it would have
+    committed is dropped by WAL.repair_epochs, which is exactly the
+    uncommitted-dispatch semantics."""
+    import struct
+    import zlib
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return 0
+    no = 0
+    for off in range(0, len(blob) - 11, 12):
+        n, crc = struct.unpack_from("<QI", blob, off)
+        if zlib.crc32(blob[off:off + 8]) == crc:
+            no = n
+    return no
+
+
+def _expand_ranges(groups, starts, counts):
+    """Per-entry (group, index) columns from per-range lists — the
+    fallback form for WAL.append_entries when a combined native call is
+    unavailable."""
+    ca = np.asarray(counts)
+    sa = np.asarray(starts)
+    offs = np.cumsum(ca) - ca
+    tot = int(ca.sum())
+    ga = np.repeat(np.asarray(groups), ca)
+    ia = np.arange(tot) - np.repeat(offs, ca) + np.repeat(sa, ca)
+    return ga, ia, ca
+
+
+class ClusterHostPlane:
+    """P peers × G groups, one device program per tick, durable WALs.
+
+    Abstract over `_device_step` (see module docstring).  Public
+    surface mirrors the distributed runtime where it overlaps:
+    `propose_many(group, payloads)` routes to the current leader peer,
+    `tick()` advances the whole cluster one step, `commit_q(peer)` is
+    that peer's totally-ordered commit stream (same item protocol as
+    RaftNode: any replayed (RAW_PLAIN, g, base, [bytes...]) batches
+    first, then the None replay-complete sentinel, then live ticks as
+    (RAW_MANY, [(g, base, [bytes...]), ...]) batch-of-batches items;
+    CLOSED ends the stream), `leader_of(group)` reports the last hint.
+    """
+
+    # Epoch-commit file rotation threshold (12 bytes/dispatch; only the
+    # last record matters — see _commit_epoch).
+    _EPOCH_ROTATE_BYTES = 1 << 20
+
+    def __init__(self, cfg: RaftConfig, data_dir: str,
+                 seed: Optional[int] = None):
+        P, G = cfg.num_peers, cfg.num_groups
+        self.cfg = cfg
+        self.metrics = NodeMetrics()
+        self.dirs = [os.path.join(data_dir, f"p{i + 1}") for i in range(P)]
+        self.wals: List[WAL] = []
+        self.plogs: List[PayloadLog] = []
+        self._commit_qs: List["queue.Queue"] = [queue.Queue()
+                                                for _ in range(P)]
+        self._applied = np.zeros((P, G), np.int64)
+        self._hard = np.zeros((P, G, 3), np.int64)
+        self._hard[:, :, 1] = -1
+        # Per-(peer, group) proposal queues as plain lists: the tick
+        # pops a whole batch with one C-level slice + del, vs a Python
+        # popleft per entry on a deque.  _prop_lock covers _props and
+        # _queued: under the threaded --fused deployment (start()),
+        # HTTP client threads propose concurrently with the tick
+        # thread's routing and batch pops.
+        self._props: List[List[list]] = [
+            [[] for _ in range(G)] for _ in range(P)]
+        self._queued: set = set()            # (peer, group) with backlog
+        self._prop_lock = threading.Lock()
+        self._hints = np.full(G, -1, np.int64)
+        self._tick_no = 0
+        # Last tick's packed info, published at the START of the next
+        # tick (overlapped with the device dispatch) — its entries are
+        # already durable by then.
+        self._pending_pinfo: Optional[np.ndarray] = None
+        # Optional apply-plane work to run INSIDE the dispatch window,
+        # right after the overlapped publish: through a remote-device
+        # tunnel the dispatch+compute wall time is idle host time, and
+        # draining/applying the commit stream there is free.  The hook
+        # must only consume the commit queues (anything else races the
+        # tick).
+        self.overlap_hook = None
+        # Which peers' commit queues receive live publishes (None =
+        # all).  Deployments that consume a single peer's stream (the
+        # --fused server and the durable bench drain peer 0) set {0}
+        # and skip 2/3 of the publish slicing + queue traffic.
+        self.publish_peers: Optional[set] = None
+        # Native KV apply plane (models/kv_native.py): when set AND the
+        # payload plane is native, peer 0's committed ranges are applied
+        # inside one C call per publish instead of being materialized as
+        # Python bytes for a queue consumer.
+        self.native_kv = None
+        # Observability (raftsql_tpu/obs/, OFF by default): a host-plane
+        # span tracer and the on-device event ring.  Every hook below is
+        # gated on these being non-None, so the disabled tick pays one
+        # attribute test and the step signatures are untouched.
+        self.tracer = None
+        self.ring = None
+        # Dynamic membership (raftsql_tpu/membership/), opt-in via
+        # enable_membership(): None keeps the static tick byte-identical
+        # (every hook gates on one attribute test).
+        self.membership = None
+        self._conf_pending: List[list] = []      # per group [(idx, data)]
+        self._conf_scrub: List[set] = []         # per group conf indexes
+        self._conf_cursor: Optional[np.ndarray] = None   # [P, G]
+        self._replayed_conf: List[Dict[int, tuple]] = [
+            {} for _ in range(P)]
+        self.error: Optional[Exception] = None
+        self._work_evt = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._tick_active = True
+        self._spin_hot = True
+        # One worker per peer for the end-of-tick durable barrier: the
+        # P per-peer fsyncs overlap (independent files; fsync releases
+        # the GIL), so the barrier costs max not sum of the fsyncs.
+        from concurrent.futures import ThreadPoolExecutor
+        self._sync_pool = ThreadPoolExecutor(
+            max_workers=P, thread_name_prefix="wal-sync")
+        # Host-plane parallelism (per-peer mirror/hardstate/fsync
+        # workers + the async publishers): only pays when the host has
+        # cores to run them on — on a 1-core host the same threads just
+        # time-slice the tick thread's core and the serial path wins
+        # (measured: 652k vs 601k commits/s at G=1000/E=64).
+        # RAFTSQL_FUSED_PARALLEL=1/0 overrides the autodetect.
+        par_env = os.environ.get("RAFTSQL_FUSED_PARALLEL", "")
+        self._host_parallel = (par_env == "1"
+                               or (par_env != "0"
+                                   and (os.cpu_count() or 1) >= 4))
+        # Serial hosts deliver a LIGHT tick's commits inline at tick end
+        # (≤ this many entries) instead of deferring a whole tick for
+        # dispatch overlap — ~0.4us/entry of publish against a full
+        # tick of ack latency.  Saturated ticks keep the deferral.
+        self._inline_publish_max = int(os.environ.get(
+            "RAFTSQL_PUBLISH_INLINE_MAX", "4096"))
+        # Steps per dispatch (RAFTSQL_FUSED_STEPS, default 1): run S
+        # consensus steps inside one device program and replay the
+        # durable phases per step on return (core/cluster.py
+        # cluster_multistep_host).  Amortizes dispatch overhead — the
+        # dominant per-tick cost through a remote-device tunnel — and
+        # lets a proposal commit within ONE dispatch (the 3-step
+        # pipeline completes before the durable barrier).  Election /
+        # heartbeat timers advance once per STEP, so election_ticks
+        # continue to mean steps, not dispatches.
+        self._steps = max(1, int(os.environ.get(
+            "RAFTSQL_FUSED_STEPS", "1")))
+        # Publish workers (parallel hosts): delivering a tick's
+        # (already durable) commits to the apply plane costs ~40% of a
+        # saturated tick's wall time; ordered workers take it off the
+        # tick thread entirely.  The fused runtime runs ONE worker; the
+        # mesh runtime runs one per group shard, each owning a disjoint
+        # group block (per-group commit order needs no cross-worker
+        # coordination).  maxsize=2 bounds the lag to one tick —
+        # enqueueing tick t's publish blocks until tick t-1's delivery
+        # started, so memory and commit-ack latency stay bounded.
+        import queue as _queue
+        self._metrics_mu = threading.Lock()
+        self._shard_groups = self._pub_shard_groups()
+        self._pub_qs: List["_queue.Queue"] = [
+            _queue.Queue(maxsize=2) for _ in range(len(self._shard_groups))]
+        self._pub_threads: List[threading.Thread] = []
+        for j, q in enumerate(self._pub_qs):
+            th = threading.Thread(
+                target=self._pub_run, args=(q, j), daemon=True,
+                name=f"publish-{j}")
+            th.start()
+            self._pub_threads.append(th)
+        # Per-peer timer skew seam: None = lockstep (every peer's timers
+        # advance 1 per step).  A [P] i32 array makes peers drift — the
+        # chaos harness's clock-skew schedules set it, modeling the real
+        # world where deployments never tick in lockstep.  Applied on
+        # the next tick(); plumbed through the runtime's per-peer
+        # timer_inc (core/cluster.py, parallel/sharded.py).
+        self.timer_inc: Optional[np.ndarray] = None
+        # Native payload plane (native/wal.cc): combined WAL+payload-log
+        # C calls, OPT-IN via RAFTSQL_FUSED_NATIVE_PLOG=1.  Measured on
+        # the Python-consumer stack it LOSES to the columnar Python
+        # payload log (104k vs 239k commits/s at G=1000/E=32): the C
+        # store must materialize fresh bytes objects on every publish,
+        # while the Python store hands the consumer the very objects it
+        # already holds.  It wins only once the apply plane itself is
+        # C++-resident (reads bytes in place) — kept for that path, and
+        # every call site degrades per-call to the Python forms.
+        self._plog_lib = (load_native_plog()
+                          if os.environ.get("RAFTSQL_FUSED_NATIVE_PLOG")
+                          == "1" else None)
+
+        # Multi-step dispatch epoch state (see tick()): the committed
+        # epoch lives in data_dir/EPOCHS (12-byte records, fsynced once
+        # per multi-step dispatch AFTER every peer's WAL barrier — the
+        # cluster-atomic commit point).  Before any replay, drop every
+        # peer's trailing UNCOMMITTED dispatch: within a dispatch peers
+        # observe each other's un-fsynced messages, and the per-peer
+        # barrier is not atomic, so a crash mid-barrier must erase the
+        # whole dispatch everywhere or a vote/append observed by one
+        # peer could survive while its sender's record did not (two
+        # leaders in one term after replay).
+        self._epoch_path = os.path.join(data_dir, "EPOCHS")
+        self._epoch_no = _read_committed_epoch(self._epoch_path)
+        self._epoch_f = None
+        self._ep_active = False
+        self._ep_begun = [False] * P
+        self._ep_no_this: Optional[int] = None
+        # Repair runs whenever any peer WAL exists — even when EPOCHS is
+        # missing (committed epoch 0): EPOCHS is created lazily by the
+        # FIRST _commit_epoch, so a crash mid-barrier during the
+        # first-ever multi-step dispatch leaves epoch-1 BEGIN-framed
+        # records durable on some peers with no EPOCHS file at all, and
+        # skipping repair would replay exactly the non-atomic dispatch
+        # (e.g. a durable vote grant whose sender's term bump was lost)
+        # this mechanism exists to drop.
+        for d in self.dirs:
+            if self._wal_exists(d):
+                self._wal_repair_epochs(d, self._epoch_no)
+
+        states = []
+        for p in range(P):
+            d = self.dirs[p]
+            if self._wal_exists(d):
+                states.append(self._replay_peer(p, d, seed))
+            else:
+                os.makedirs(d, exist_ok=True)
+                self.wals.append(self._new_wal(d))
+                self.plogs.append(
+                    NativePayloadLog(G, self._plog_lib)
+                    if self._plog_lib is not None else PayloadLog(G))
+                states.append(None)
+            # Replay-complete sentinel, replayed-or-not (the reference's
+            # nil on commitC, raft.go:131-132).
+            self._commit_qs[p].put(None)
+        if all(s is None for s in states):
+            self.states = init_cluster_state(cfg, seed)
+        else:
+            per_peer = [s if s is not None
+                        else restore_peer_state(cfg, p, {}, {}, seed)
+                        for p, s in enumerate(states)]
+            self.states = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                       *per_peer)
+        self.inboxes = empty_cluster_inbox(cfg)
+        self._E = cfg.max_entries_per_msg
+
+    # -- subclass seams -------------------------------------------------
+
+    def _device_step(self, prop_n: np.ndarray,
+                     timer_inc: Optional[np.ndarray] = None):
+        """Dispatch one cluster step; returns (packed-info device array,
+        device busy bit or None).  `timer_inc` is the per-peer [P]
+        timer advance (None = lockstep 1s, the steady-state fast path).
+        Implemented by the concrete runtime — the durable host plane in
+        this class is identical either way."""
+        raise NotImplementedError
+
+    def _new_wal(self, dirname: str) -> WAL:
+        """Construct a peer's durable log handle.  The mesh runtime
+        overrides this with a per-group-shard layout (ShardedWAL)."""
+        return WAL(dirname, segment_bytes=self.cfg.wal_segment_bytes)
+
+    def _wal_exists(self, dirname: str) -> bool:
+        return wal_exists(dirname)
+
+    def _wal_replay(self, dirname: str):
+        return WAL.replay(dirname)
+
+    def _wal_repair_epochs(self, dirname: str, committed: int) -> None:
+        WAL.repair_epochs(dirname, committed)
+
+    def _pub_shard_groups(self) -> List[Optional[np.ndarray]]:
+        """One entry per ordered publish worker: the group-id block it
+        owns (None = all groups).  Workers' blocks MUST be disjoint —
+        each group's commit stream is then FIFO through exactly one
+        worker, which is what preserves per-group publish order."""
+        return [None]
+
+    def _note_commits(self, n: int) -> None:
+        """Commit-counter increment, safe from concurrent publish
+        workers (disjoint groups, shared counter)."""
+        with self._metrics_mu:
+            self.metrics.commits += n
+
+    # -- boot -----------------------------------------------------------
+
+    def _replay_peer(self, p: int, d: str, seed):
+        """Rebuild peer p from its WAL (RestartNode, raft.go:122-134):
+        device state, payload log, and the replayed committed prefix
+        published to its commit stream."""
+        logs = self._wal_replay(d)
+        self._replayed_conf[p] = {g: gl.conf for g, gl in logs.items()
+                                  if gl.conf is not None}
+        self.wals.append(self._new_wal(d))
+        plog = (NativePayloadLog(self.cfg.num_groups, self._plog_lib)
+                if self._plog_lib is not None
+                else PayloadLog(self.cfg.num_groups))
+        self.plogs.append(plog)
+        log_terms: Dict[int, list] = {}
+        hard: Dict[int, tuple] = {}
+        starts: Dict[int, tuple] = {}
+        for g, gl in logs.items():
+            log_terms[g] = [t for (t, _) in gl.entries]
+            hard[g] = (gl.hard.term, gl.hard.vote, gl.hard.commit)
+            if gl.start:
+                starts[g] = (gl.start, gl.start_term)
+                plog.set_start(g, gl.start, gl.start_term)
+            plog.put(g, gl.start + 1, [dt for (_, dt) in gl.entries],
+                     [t for (t, _) in gl.entries])
+            self._hard[p, g] = hard[g]
+            commit = gl.hard.commit
+            self._applied[p, g] = commit
+            datas = plog.try_slice(g, gl.start + 1,
+                                   max(commit - gl.start, 0))
+            if datas:
+                self._commit_qs[p].put((RAW_PLAIN, g, gl.start, datas))
+        return restore_peer_state(self.cfg, p, log_terms, hard, seed,
+                                  starts=starts or None)
+
+    # -- client plane ---------------------------------------------------
+
+    def commit_q(self, peer: int) -> "queue.Queue":
+        return self._commit_qs[peer]
+
+    def leader_of(self, group: int) -> int:
+        """Last known leader peer (0-based), -1 unknown."""
+        return int(self._hints[group])
+
+    def enable_tracing(self, ring_depth: int = 64,
+                       keep: int = 4096) -> None:
+        """Turn on both observability planes (raftsql_tpu/obs/): the
+        host span tracer and the on-device event ring.  Safe to call
+        before the tick loop starts; idempotent."""
+        from raftsql_tpu.obs.device_ring import DeviceEventRing
+        from raftsql_tpu.obs.spans import SpanTracer
+        if self.tracer is None:
+            self.tracer = SpanTracer()
+        if self.ring is None:
+            self.ring = DeviceEventRing(self.cfg.num_peers,
+                                        self.cfg.num_groups,
+                                        depth=ring_depth, keep=keep)
+        for w in self.wals:
+            w.obs = self.tracer
+
+    # -- dynamic membership (raftsql_tpu/membership/) -------------------
+
+    def enable_membership(self, initial_voters=None) -> None:
+        """Attach the membership plane: per-group voter masks as device
+        state, conf entries applied per PEER ROW as that row's commit
+        passes them, durable REC_CONF baselines per peer WAL.  Restores
+        each peer's active config from its replayed WAL (baseline +
+        retained conf entries).  Call before the tick loop; idempotent."""
+        from raftsql_tpu.membership import MembershipManager
+        if self.membership is not None:
+            return
+        P, G = self.cfg.num_peers, self.cfg.num_groups
+        iv = initial_voters if initial_voters is not None \
+            else self.cfg.initial_voters
+        mm = MembershipManager(P, G, initial_voters=iv)
+        self._conf_pending = [[] for _ in range(G)]
+        self._conf_scrub = [set() for _ in range(G)]
+        self._conf_cursor = np.zeros((P, G), np.int64)
+        pend: List[Dict[int, bytes]] = [dict() for _ in range(G)]
+        for p in range(P):
+            view = MembershipManager(P, G, initial_voters=iv)
+            for g in range(G):
+                base = self._replayed_conf[p].get(g)
+                plog = self.plogs[p]
+                start, ln = plog.start(g), plog.length(g)
+                datas = plog.try_slice(g, start + 1, ln - start) \
+                    if ln > start else []
+                entries = [(0, d) for d in (datas or [])]
+                if view.restore(g, base, entries, start,
+                                int(self._hard[p, g, 2])):
+                    c = view.config(g)
+                    self._patch_conf_row(p, g, c.entry(0))
+                    self._conf_cursor[p, g] = c.index
+                    # The cluster authority adopts the most advanced
+                    # per-group view (full-picture entries make this a
+                    # plain superseding apply).
+                    mm.apply(g, c.index, c.entry(0))
+                for idx, d in view.appended_list(g):
+                    pend[g].setdefault(idx, d)
+        self.membership = mm
+        for g in range(G):
+            for idx in sorted(pend[g]):
+                self._conf_note(g, idx, pend[g][idx])
+
+    def _conf_note(self, g: int, idx: int, data: bytes) -> None:
+        """A conf entry entered some peer's log at `idx` (tick thread)."""
+        lst = self._conf_pending[g]
+        lst[:] = [(i, d) for (i, d) in lst if i != idx]
+        lst.append((idx, data))
+        lst.sort()
+        # New set object (not in-place add): the publisher thread scrubs
+        # from whatever reference it grabbed — no concurrent mutation.
+        self._conf_scrub[g] = self._conf_scrub[g] | {idx}
+
+    def _patch_conf_row(self, p: int, g: int, data: bytes) -> None:
+        got = decode_conf_entry(data)
+        if got is None:
+            return
+        _, v, j, _l = got
+        P = self.cfg.num_peers
+        vrow = np.array([bool(v >> i & 1) for i in range(P)])
+        jrow = np.array([bool(j >> i & 1) for i in range(P)])
+        self.states = set_group_config_stacked(
+            self.states, p, g, vrow, jrow, bool((v | j) >> p & 1))
+
+    def _membership_advance(self, pinfo: np.ndarray) -> None:
+        """Apply pending conf entries to each peer row whose commit
+        passed them, drive the auto LEAVE_JOINT, and keep the cluster
+        authority in sync.  Tick thread, after the durable phases."""
+        mm = self.membership
+        P = self.cfg.num_peers
+        commit = pinfo[:, :, _C["commit"]]
+        for g, lst in enumerate(self._conf_pending):
+            if not lst:
+                continue
+            drop: List[int] = []
+            for (idx, data) in list(lst):
+                all_done = True
+                superseded = False
+                for p in range(P):
+                    if self._conf_cursor[p, g] >= idx:
+                        continue
+                    if commit[p, g] < idx:
+                        all_done = False
+                        continue
+                    got = self.plogs[p].try_slice(g, idx, 1)
+                    if got is None:
+                        continue          # compacted under us: settled
+                    if got[0] != data:
+                        # Conflict truncation rewrote the slot before
+                        # commit: this conf never happened.
+                        superseded = True
+                        break
+                    self._patch_conf_row(p, g, data)
+                    self._conf_cursor[p, g] = idx
+                    # Per-peer durable baseline: THIS entry's masks (the
+                    # cluster authority may already be ahead).
+                    _k, cv, cj, cl = decode_conf_entry(data)
+                    self.wals[p].set_conf(g, idx, _k, cv, cj, cl)
+                    if mm.apply(g, idx, data) is not None:
+                        self.metrics.conf_changes_applied += 1
+                if superseded:
+                    mm.abort_pending(g)      # the change never happened
+                if superseded or all_done:
+                    drop.append(idx)
+            if drop:
+                lst[:] = [(i, d) for (i, d) in lst if i not in drop]
+        # Whichever peer leads a joint group finishes the transition.
+        for g in list(mm.joint_groups):
+            if self._hints[g] >= 0:
+                entry = mm.maybe_leave(g, self._tick_no,
+                                       4 * self.cfg.election_ticks)
+                if entry is not None:
+                    self.propose_many(g, [entry])
+
+    def members_doc(self) -> dict:
+        if self.membership is None:
+            return {"error": "membership plane not enabled "
+                             "(enable_membership())"}
+        out = {}
+        for g in range(self.cfg.num_groups):
+            d = self.membership.describe(g)
+            d["leader"] = self.leader_of(g) + 1
+            out[str(g)] = d
+        return {"num_peers": self.cfg.num_peers, "groups": out,
+                "node": 0}
+
+    def member_change(self, group: int, op: str, peer: int) -> dict:
+        """Admin plane for the co-located cluster: every peer lives in
+        this process, so routing goes through propose_many's leader
+        hint instead of a wire forward."""
+        from raftsql_tpu.membership import MembershipLagError
+        if self.membership is None:
+            raise RuntimeError("membership plane not enabled "
+                               "(enable_membership())")
+        if op == "promote":
+            lead = int(self._hints[group])
+            commit = int(self._hard[max(lead, 0), group, 2])
+            have = self.plogs[peer].length(group)
+            if commit - have > self.cfg.max_entries_per_msg:
+                raise MembershipLagError(
+                    f"group {group}: learner {peer} is "
+                    f"{commit - have} entries behind; retry after "
+                    "catch-up")
+        entry = self.membership.make_change(group, op, peer)
+        self.propose_many(group, [entry])
+        return self.membership.describe(group)
+
+    def propose_many(self, group: int, payloads) -> None:
+        """Queue payloads at the group's current leader peer (host-side
+        routing — all peers share this process; the distributed
+        runtime's forward-over-transport becomes a list move)."""
+        if self.tracer is not None:
+            for d in payloads:
+                self.tracer.begin(group,
+                                  d.decode("utf-8", "replace"))
+        p = int(self._hints[group])
+        if p < 0:
+            p = 0
+        with self._prop_lock:
+            self._props[p][group].extend(payloads)
+            self._queued.add((p, group))
+        self._work_evt.set()
+
+    # -- threaded serving (single-process deployments) ------------------
+
+    def start(self, interval_s: float = 0.002) -> None:
+        """Run the tick loop on a background thread: wake immediately
+        on proposals; tick at `interval_s` while consensus is active;
+        PARK at a 0.5 s safety heartbeat once the cluster is quiet
+        (nothing queued, committed-but-unpublished, leaderless, written
+        this tick, or busy on-device — see the runtime's busy bit).
+        Pausing a quiet cluster is safe precisely because it is
+        single-controller: ALL peers pause together, so no peer can
+        observe missed heartbeats, no timer skews, and elections fire
+        only when a group actually lacks a leader."""
+        def _run():
+            while not self._stop_evt.is_set():
+                self._work_evt.clear()
+                try:
+                    self.tick()
+                except Exception as e:   # pragma: no cover - defensive
+                    self.error = e
+                    for q in self._commit_qs:
+                        q.put(CLOSED)
+                    return
+                # Idle parking: a QUIET single-controller cluster can
+                # pause consensus outright — every peer pauses with it,
+                # so no election can fire spuriously and nothing is
+                # missed; the next proposal (work event) resumes it.
+                # The 0.5 s cap is a safety heartbeat.  While HOT
+                # (client work in flight), loop back-to-back: the
+                # tick's own wall time is the pacing, and relative
+                # timer safety (heartbeat period < election timeout)
+                # holds at any wall rate because all peers step
+                # together — each saved interval_s is a propose→commit
+                # pipeline hop clients don't wait.  ACTIVE-but-not-hot
+                # (e.g. leaderless warmup) paces at interval_s.
+                if not self._tick_active:
+                    self._work_evt.wait(0.5)
+                elif not self._spin_hot:
+                    self._work_evt.wait(interval_s)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="cluster-tick")
+        self._thread.start()
+
+    # -- linearizable reads (single-controller cluster) -----------------
+
+    def read_index(self, group: int):
+        """ReadIndex for the co-located cluster: every peer of the
+        group lives in THIS process, so no other process can hold a
+        newer leadership — the leader's current commit index IS the
+        linearization point, no quorum round needed.  Returns () while
+        the group has no leader yet (caller polls)."""
+        p = int(self._hints[group])
+        if p < 0:
+            return ()
+        return int(self._hard[p, group, 2]), 0
+
+    def read_ready(self, group: int, reg_tick: int) -> bool:
+        return True
+
+    # -- the tick -------------------------------------------------------
+
+    def _build_prop_n(self, steps: int = 1) -> np.ndarray:
+        """Per-dispatch proposal counts.  steps == 1: [P, G], up to E
+        per group.  steps > 1 (multi-step dispatch): [S, P, G] — each
+        step gets its own ≤E chunk of the backlog, so one dispatch can
+        accept (and commit) up to S×E per group.  The device may accept
+        less at any step (window pressure); the host pops exactly what
+        each step REPORTS accepted, in step order, and offers were cut
+        from one backlog snapshot — so pops never outrun the queue and
+        payloads stay aligned with the device's assigned indexes."""
+        P, G = self.cfg.num_peers, self.cfg.num_groups
+        cap = self._E * steps
+        prop_n = np.zeros((P, G), np.int32)
+        dead = []
+        with self._prop_lock:
+            for (p, g) in list(self._queued):  # snapshot: re-routes mutate
+                q = self._props[p][g]
+                if not q:
+                    dead.append((p, g))
+                    continue
+                h = int(self._hints[g])
+                if 0 <= h != p:
+                    # Re-route a backlog stranded at a deposed/wrong peer.
+                    self._props[h][g].extend(q)
+                    q.clear()
+                    self._queued.add((h, g))
+                    dead.append((p, g))
+                    continue
+                prop_n[p, g] = min(len(q), cap)
+            for k in dead:
+                self._queued.discard(k)
+        if steps <= 1:
+            return prop_n
+        return np.stack([np.clip(prop_n - s * self._E, 0, self._E)
+                         for s in range(steps)]).astype(np.int32)
+
+    def _pub_run(self, q: "queue.Queue", shard: int) -> None:
+        """Ordered publish worker (see __init__): per worker one queue,
+        one disjoint group block, FIFO — publishes retire in tick
+        order.  `_applied` and the commit queues for a given group are
+        touched only by its owning worker after construction, so the
+        cursor needs no lock; compact() reads _applied from other
+        threads but a stale (lower) value only makes its floor more
+        conservative."""
+        import time as _t
+        while True:
+            item = q.get()
+            try:
+                # After a publish fault, keep draining (so flush/stop
+                # never hang) but publish nothing more: the CLOSED
+                # sentinel must stay the queues' last item.
+                if item is not None and self.error is None:
+                    t0 = _t.monotonic()
+                    self._publish_shard(item, shard)
+                    with self._metrics_mu:
+                        self.metrics.t_publish_ms += \
+                            (_t.monotonic() - t0) * 1e3
+            except Exception as e:
+                self.error = e
+                for cq in self._commit_qs:
+                    cq.put(CLOSED)
+            finally:
+                q.task_done()
+            if item is None:
+                return
+
+    def _enqueue_publish(self, pinfo: np.ndarray) -> None:
+        """Hand a durable tick's packed info to every publish worker
+        (each delivers only its own group block)."""
+        for q in self._pub_qs:
+            q.put(pinfo)
+
+    def publish_flush(self) -> None:
+        """Block until every enqueued publish has been delivered (the
+        bench and tests read apply-plane state right after a tick
+        loop).  Re-raises a publish fault — the async path must fail as
+        loudly as the inline one did."""
+        for q in self._pub_qs:
+            q.join()
+        if self.error is not None:
+            raise self.error
+
+    def _ensure_epoch_begin(self, p: int) -> None:
+        """Lazily open peer p's dispatch frame: the BEGIN marker is
+        written only when the dispatch actually writes to that peer's
+        WAL (an idle multi-step tick costs zero records and zero epoch
+        fsyncs).  Safe from the per-peer workers: each touches only its
+        own slot, and the epoch-number allocation is idempotent."""
+        if not self._ep_active or self._ep_begun[p]:
+            return
+        if self._ep_no_this is None:
+            self._ep_no_this = self._epoch_no + 1
+        self._ep_begun[p] = True
+        self.wals[p].epoch_mark(self._ep_no_this, end=False)
+
+    def _commit_epoch(self, no: int) -> None:
+        """The multi-step dispatch's atomic commit point: append the
+        epoch number to data_dir/EPOCHS and fsync it — AFTER every
+        peer's WAL barrier, BEFORE publish.  Recovery drops any
+        dispatch whose number never made it here."""
+        import struct
+        import zlib
+        created = False
+        if self._epoch_f is None:
+            created = not os.path.exists(self._epoch_path)
+            self._epoch_f = open(self._epoch_path, "ab")
+        rec = struct.pack("<Q", no)
+        fsio.write(self._epoch_f,
+                   rec + struct.pack("<I", zlib.crc32(rec)))
+        fsio.fsync_file(self._epoch_f)
+        if created:
+            # Dirent durability for the just-created file, BEFORE the
+            # epoch counts as committed: the record fsync above makes
+            # the bytes durable but not the directory entry — a crash
+            # could drop the whole file, and recovery would then
+            # misclassify committed (already published/acked)
+            # dispatches as uncommitted.  Mirrors the rotation path.
+            fsio.fsync_dir(os.path.dirname(self._epoch_path) or ".")
+        if self._epoch_f.tell() >= self._EPOCH_ROTATE_BYTES:
+            # Rotate: only the LAST record matters for recovery.  Write
+            # a one-record replacement beside the live file, fsync it,
+            # atomically swap (rename is the commit), fsync the dir.
+            tmp = self._epoch_path + ".tmp"
+            with open(tmp, "wb") as f:
+                fsio.write(f, rec + struct.pack("<I", zlib.crc32(rec)))
+                fsio.fsync_file(f)
+            os.replace(tmp, self._epoch_path)
+            fsio.fsync_dir(os.path.dirname(self._epoch_path) or ".")
+            self._epoch_f.close()
+            self._epoch_f = open(self._epoch_path, "ab")
+
+    def _save_hard(self, p: int, pinfo: np.ndarray) -> bool:
+        """Write peer p's changed hard states (term/vote/commit) to its
+        WAL, AFTER the tick's entry records (etcd wal.Save order: a
+        torn tail can then never leave a hard state referencing lost
+        entries).  Shared by the serial phase 2c and the parallel
+        per-peer workers; True when anything changed."""
+        col = pinfo[p]
+        hs = np.stack([col[:, _C["term"]], col[:, _C["voted_for"]],
+                       col[:, _C["commit"]]], axis=1)
+        changed = np.nonzero((hs != self._hard[p]).any(axis=1))[0]
+        if not changed.size:
+            return False
+        self._ensure_epoch_begin(p)
+        self.wals[p].set_hardstates(changed, hs[changed, 0],
+                                    hs[changed, 1], hs[changed, 2])
+        self._hard[p][changed] = hs[changed]
+        return True
+
+    def tick(self) -> None:
+        """One device step + the durable host phase.
+
+        Order (the contract in the module docstring): dispatch → (while
+        the device runs: publish the PREVIOUS tick's commits — they are
+        already durable) → read packed info → mirror-reads → WAL +
+        payload-log writes → fsync every peer.  The NEXT dispatch cannot
+        happen before this method returns, so every message composed
+        this tick is durable on its sender before any receiver observes
+        it; publish always runs after the save of the tick it publishes.
+        """
+        import time as _t
+        t0 = _t.monotonic()
+        # Snapshot _queued: _build_prop_n may re-route into the set.
+        prop_n = self._build_prop_n(self._steps)
+        ti = self.timer_inc
+        if ti is not None:
+            # Skew accounting: how far this tick's timer advances
+            # deviate from lockstep, per peer, summed.
+            self.metrics.faults_skew_ticks += int(
+                np.abs(np.asarray(ti, np.int64) - 1).sum())
+        pinfo_dev, busy_dev = self._device_step(prop_n, ti)
+        if self.ring is not None:
+            # Device-plane event ring: one extra small fused program
+            # over arrays already resident (tracing-on cost only); the
+            # ring stays on device and drains to host in batches.  A
+            # multi-step dispatch records its final step — the ring is
+            # tick-indexed at dispatch granularity, like the runtime.
+            self.ring.record(self._tick_no,
+                             pinfo_dev if self._steps == 1
+                             else pinfo_dev[-1],
+                             self.states.votes, self.inboxes.v_type,
+                             self.inboxes.a_type, self._applied)
+        t1 = _t.monotonic()
+        # Overlap: tick t-1's commits are durable (fsynced last tick).
+        # Parallel hosts hand them to the publish workers (the apply
+        # plane runs concurrently with this whole tick); a 1-core host
+        # delivers inline while the device computes.
+        if self._pending_pinfo is not None:
+            if self._host_parallel:
+                self._enqueue_publish(self._pending_pinfo)
+            else:
+                tp = _t.monotonic()
+                self._publish(self._pending_pinfo)
+                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+            self._pending_pinfo = None
+        t2 = _t.monotonic()
+        if self.overlap_hook is not None:
+            # Hook wall time is the caller's (apply-plane) cost, not a
+            # tick phase: charge it to neither publish nor device.
+            self.overlap_hook()
+            t2b = _t.monotonic()
+        else:
+            t2b = t2
+        if busy_dev is not None:
+            pinfo, dev_busy = jax.device_get((pinfo_dev, busy_dev))
+            pinfo = np.asarray(pinfo)
+            dev_busy = bool(dev_busy)
+        else:
+            pinfo = np.asarray(jax.device_get(pinfo_dev))  # [P,G,NCOLS]
+            dev_busy = True
+        t3 = _t.monotonic()
+
+        # Multi-step dispatch (RAFTSQL_FUSED_STEPS > 1): packed info
+        # arrives stacked [S, P, G, C]; the host replays its durable
+        # phases in step order — every step's entries land before the
+        # ONE hard-state save + fsync barrier of the dispatch, which
+        # preserves the etcd wal.Save order (entries-then-hardstate)
+        # at dispatch granularity.
+        step_infos = ([np.asarray(pinfo[s])
+                       for s in range(pinfo.shape[0])]
+                      if pinfo.ndim == 4 else [pinfo])
+        pinfo = step_infos[-1]
+        self._hints = pinfo[0, :, _C["leader_hint"]]
+        # Multi-step dispatches are epoch-framed (see _ensure_epoch_
+        # begin / _commit_epoch): BEGIN lazily wraps each peer's first
+        # write, END lands before its fsync, and the dispatch commits
+        # atomically below.
+        self._ep_active = len(step_infos) > 1
+        if self._ep_active:
+            self._ep_begun = [False] * self.cfg.num_peers
+            self._ep_no_this = None
+        tick_active = False
+        for si, pi in enumerate(step_infos):
+            tick_active = self._durable_phases(
+                pi, final=(si == len(step_infos) - 1)) or tick_active
+        if self._ep_active and self._ep_no_this is not None:
+            # Every peer's barrier is down; this fsync is the
+            # dispatch's atomic commit point (before any publish).
+            self._epoch_no = self._ep_no_this
+            self._commit_epoch(self._epoch_no)
+        self._ep_active = False
+        if self.membership is not None:
+            # Apply-at-commit for conf entries: patch each peer row
+            # whose commit passed a pending entry, BEFORE this tick's
+            # publish enqueue (the scrub set must cover the batch).
+            self._membership_advance(pinfo)
+        t4 = _t.monotonic()
+        # Quiescence signal for the threaded loop: anything written,
+        # any group leaderless, or any proposal backlog means "keep
+        # ticking at full pace".
+        base_active = (tick_active
+                       or dev_busy
+                       or bool((self._hints < 0).any())
+                       or bool(self._queued))
+        # HOT means real client work is flowing (writes this tick, a
+        # device dispatch still in flight, or a proposal backlog): the
+        # threaded loop then ticks back-to-back.  Merely-leaderless
+        # groups keep the loop ACTIVE (elections must advance) but not
+        # hot — warmup paces at interval_s instead of starving the
+        # host core the cluster shares with its clients.
+        self._spin_hot = tick_active or dev_busy or bool(self._queued)
+        if base_active:
+            if self._host_parallel:
+                # The publish workers ARE the overlap: hand the tick's
+                # commits over right after the durable barrier instead
+                # of deferring to the next tick's dispatch window —
+                # one whole tick less propose→ack latency.
+                self._enqueue_publish(pinfo)
+            else:
+                # Serial host: defer-and-overlap pays only when the
+                # publish is expensive.  A light tick's batch (a few
+                # serving requests) costs far less to deliver NOW than
+                # the whole tick of ack latency the deferral adds.
+                delta = int(np.clip(
+                    pinfo[0][:, _C["commit"]] - self._applied[0],
+                    0, None).sum())
+                if delta <= self._inline_publish_max:
+                    tp = _t.monotonic()
+                    self._publish(pinfo)
+                    self.metrics.t_publish_ms += \
+                        (_t.monotonic() - tp) * 1e3
+                    self._pending_pinfo = None
+                else:
+                    self._pending_pinfo = pinfo  # next tick overlaps
+        else:
+            # About to go quiet: deliver this tick's commits NOW (they
+            # are fsynced above) instead of deferring to a next tick
+            # that may be a parked 0.5s away — the deferral only pays
+            # when another dispatch immediately follows to overlap.
+            if self._host_parallel:
+                self._enqueue_publish(pinfo)
+            else:
+                tp = _t.monotonic()
+                self._publish(pinfo)
+                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+            self._pending_pinfo = None
+        self._tick_active = base_active
+        self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
+        self.metrics.t_wal_ms += (t4 - t3) * 1e3
+        self._tick_no += 1
+        self.metrics.ticks += 1
+
+    def _durable_phases(self, pinfo: np.ndarray, final: bool) -> bool:
+        """The durable host phases for ONE step's packed info [P,G,C]:
+        phase 1 collects mirror METADATA (peer, src, group, start,
+        count, new_len) with no reads; phase 2a writes leader appends
+        (fresh-leader no-ops + accepted proposals) as uniform-term
+        RANGES; phase 2b mirrors follower appends.  Mirror-source
+        staging happens inside 2b AFTER 2a's appends — safe because 2a
+        writes are pure TAIL appends strictly above any mirrored range
+        (mirror ranges were composed from the source's ring at the end
+        of the PREVIOUS step), and the only same-step writes that can
+        truncate or overwrite a mirrored range are OTHER MIRRORS, which
+        both 2b paths stage fully before writing.  Any future 2a change
+        that is not a pure tail append breaks this argument and must
+        move 2a after 2b's staging.
+
+        On the dispatch's FINAL step only, phase 2c (hard states) and
+        the per-peer fsync barrier run — a multi-step dispatch saves
+        every step's entries, then one hard state, then one fsync,
+        which is the etcd wal.Save order at dispatch granularity.
+        Returns tick_active (entries or hard states written)."""
+        P = self.cfg.num_peers
+        m_peer: List[int] = []
+        m_src: List[int] = []
+        m_g: List[int] = []
+        m_start: List[int] = []
+        m_count: List[int] = []
+        m_newlen: List[int] = []
+        for p in range(P):
+            col = pinfo[p]
+            accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
+            if not accepted.size:
+                continue
+            sub = col[accepted]
+            m_peer.extend([p] * accepted.size)
+            m_g.extend(accepted.tolist())
+            m_src.extend(sub[:, _C["app_from"]].tolist())
+            m_start.extend(sub[:, _C["app_start"]].tolist())
+            m_count.extend(sub[:, _C["app_n"]].tolist())
+            m_newlen.extend(sub[:, _C["new_log_len"]].tolist())
+
+        if self.tracer is not None and m_peer:
+            # Replicate stamp: the mirrored range is landing in a
+            # follower's log this step (first stamp wins per index).
+            for g, st, c in zip(m_g, m_start, m_count):
+                if c:
+                    self.tracer.note_replicate(g, st + c - 1)
+
+        # Phase 2a: leader appends (fresh-leader no-ops + accepted
+        # proposals) as uniform-term RANGES per peer: one combined
+        # native call writes the WAL records and the payload-log range
+        # (wal.append_ranges_uniform); the fallback expands ranges to
+        # per-entry numpy columns for the classic two-call path.
+        tick_active = bool(m_peer)
+        for p in range(P):
+            col = pinfo[p]
+            noop = col[:, _C["noop"]]
+            acc = col[:, _C["prop_accepted"]]
+            base = col[:, _C["prop_base"]]
+            term = col[:, _C["term"]]
+            r_g: List[int] = []
+            r_start: List[int] = []
+            r_count: List[int] = []
+            r_term: List[int] = []
+            w_d: List[bytes] = []
+            ngs = np.nonzero(noop)[0]
+            if ngs.size:
+                # One empty record at prop_base per fresh leader
+                # (ordered before any accepted proposals of the same
+                # group — base < base+1, both pure tail appends).
+                r_g.extend(ngs.tolist())
+                r_start.extend(base[ngs].tolist())
+                r_count.extend([1] * ngs.size)
+                r_term.extend(term[ngs].tolist())
+                w_d.extend([b""] * ngs.size)
+            ags = np.nonzero(acc > 0)[0]
+            if ags.size:
+                props_p = self._props[p]
+                traced = [] if self.tracer is not None else None
+                confs = [] if self.membership is not None else None
+                with self._prop_lock:   # pops race client-thread extends
+                    for g, n, b0, tm in zip(ags.tolist(),
+                                            acc[ags].tolist(),
+                                            (base[ags] + 1).tolist(),
+                                            term[ags].tolist()):
+                        q = props_p[g]
+                        batch = q[:n]
+                        del q[:n]
+                        w_d.extend(batch)
+                        r_g.append(g)
+                        r_start.append(b0)
+                        r_count.append(n)
+                        r_term.append(tm)
+                        if traced is not None:
+                            traced.append((g, b0, batch))
+                        if confs is not None:
+                            # Conf entries entering the cluster log —
+                            # one leading-byte test per accepted
+                            # proposal, only with membership enabled.
+                            for off, d in enumerate(batch):
+                                if d[:1] == _CONF_PREFIX \
+                                        and is_conf_entry(d):
+                                    confs.append((g, b0 + off, d))
+                if confs:
+                    for (cg, cidx, cd) in confs:
+                        self._conf_note(cg, cidx, cd)
+                self.metrics.proposals += int(acc[ags].sum())
+                if traced:
+                    # Append stamp + index binding, outside the lock.
+                    for g, b0, batch in traced:
+                        self.tracer.note_append(
+                            g, b0, [d.decode("utf-8", "replace")
+                                    for d in batch])
+            if not r_g:
+                continue
+            tick_active = True
+            self._ensure_epoch_begin(p)
+            plog_native = (self.plogs[p]
+                           if hasattr(self.plogs[p], "handle") else None)
+            wrote = False
+            if plog_native is not None:
+                blob = b"".join(w_d)
+                lens = np.fromiter(map(len, w_d), np.uint32, len(w_d))
+                wrote = self.wals[p].append_ranges_uniform(
+                    plog_native, r_g, r_start, r_count, r_term, blob,
+                    lens)
+            if not wrote:
+                # Python plog path: RANGE records — one framed record
+                # per (group, start, term) run, not one per entry.
+                self.wals[p].append_ranges(r_g, r_start, r_count,
+                                           r_term, w_d)
+                puts = []
+                pos = 0
+                for g, s, c, tm in zip(r_g, r_start, r_count, r_term):
+                    puts.append((g, s, w_d[pos: pos + c], [tm] * c,
+                                 None))
+                    pos += c
+                self.plogs[p].put_ranges(puts)
+
+        # Phases 2b+2c+fsync, PARALLEL per peer when the native plane
+        # is up: worker p runs [mirrors INTO peer p] + [peer p's hard
+        # states] + [peer p's fsync].  Safe to run concurrently: phase
+        # 2a's appends are complete; a group's mirror source (its
+        # leader's plog) and dest (a follower's) are different peers,
+        # and since a group has ONE leader, worker A writing group g'
+        # into plog[X] can never touch the group-g ranges worker B
+        # reads FROM plog[X] — per-group data is disjoint across
+        # workers, and every C structure carries its own mutex.  This
+        # overlaps the 3x payload memcpy + write + fsync across cores
+        # instead of serializing them on the tick thread.
+        par_ok = (final
+                  and self._host_parallel
+                  and self.wals
+                  and self.wals[0]._lib is not None
+                  and hasattr(self.wals[0]._lib, "walplog_mirror_all")
+                  and all(w._lib is not None for w in self.wals)
+                  and all(hasattr(pl, "handle") for pl in self.plogs))
+        if par_ok and m_peer:
+            # Per-group disjointness holds per LEADER, and a leader can
+            # change within a tick: group g's old leader X may accept
+            # from new leader Y (mirror INTO plog[X], with truncation)
+            # in the same tick another peer still mirrors g FROM
+            # plog[X].  Concurrent workers would then write a source
+            # mid-read.  Detect it (a group whose mirror source is also
+            # one of its mirror dests) and take the serial staged path
+            # for this tick — it is an election-tick rarity.
+            dests: Dict[int, set] = {}
+            for g, p in zip(m_g, m_peer):
+                dests.setdefault(g, set()).add(p)
+            for g, s in zip(m_g, m_src):
+                if s in dests.get(g, ()):
+                    par_ok = False
+                    break
+        if par_ok:
+            by_peer: List[List[int]] = [[] for _ in range(P)]
+            for i, mp in enumerate(m_peer):
+                by_peer[mp].append(i)
+
+            def _host_peer(p: int) -> bool:
+                idx = by_peer[p]
+                if idx:
+                    self._ensure_epoch_begin(p)
+                    wal_mirror_all(
+                        self.wals, self.plogs,
+                        [m_peer[i] for i in idx],
+                        [m_src[i] for i in idx],
+                        [m_g[i] for i in idx],
+                        [m_start[i] for i in idx],
+                        [m_count[i] for i in idx],
+                        [m_newlen[i] for i in idx])
+                changed = self._save_hard(p, pinfo)
+                if self._ep_begun[p]:
+                    self.wals[p].epoch_mark(self._ep_no_this, end=True)
+                self.wals[p].sync()
+                return changed
+
+            for act in self._sync_pool.map(_host_peer, range(P)):
+                tick_active = tick_active or act
+        elif m_peer:
+            for p in sorted(set(m_peer)):
+                self._ensure_epoch_begin(p)
+            if not wal_mirror_all(self.wals, self.plogs, m_peer, m_src,
+                                  m_g, m_start, m_count, m_newlen):
+                # Python two-pass fallback: ALL source reads first (the
+                # staging contract), then one batched write per peer.
+                reads = [self.plogs[s].slice_columns(g, st, c)
+                         if c else ([], [])
+                         for (s, g, st, c) in zip(m_src, m_g, m_start,
+                                                  m_count)]
+                for p in range(P):
+                    b_g: List[int] = []
+                    b_start: List[int] = []
+                    b_count: List[int] = []
+                    b_terms: List[int] = []
+                    b_d: List[bytes] = []
+                    puts = []
+                    for (mp, g, st, c, nl), (terms, datas) in zip(
+                            zip(m_peer, m_g, m_start, m_count,
+                                m_newlen), reads):
+                        if mp != p:
+                            continue
+                        puts.append((g, st, datas, terms, nl))
+                        if c:
+                            b_g.append(g)
+                            b_start.append(st)
+                            b_count.append(c)
+                            b_terms.extend(terms)
+                            b_d.extend(datas)
+                    if puts:
+                        self.plogs[p].put_ranges(puts)
+                    if b_g:
+                        # Mirrored batches may cross term boundaries;
+                        # RANGE records are uniform-term, so split each
+                        # mirror at its term changes (rare: elections).
+                        s_g: List[int] = []
+                        s_start: List[int] = []
+                        s_count: List[int] = []
+                        s_term: List[int] = []
+                        pos = 0
+                        for g, st0, c in zip(b_g, b_start, b_count):
+                            for (rs, rc, rt) in split_uniform_runs(
+                                    st0, b_terms[pos: pos + c]):
+                                s_g.append(g)
+                                s_start.append(rs)
+                                s_count.append(rc)
+                                s_term.append(rt)
+                            pos += c
+                        self.wals[p].append_ranges(s_g, s_start, s_count,
+                                                   s_term, b_d)
+
+        # Phase 2c (serial path only — the parallel path folded hard
+        # states + fsync into its per-peer workers): hard states after
+        # every ENTRY record of the tick (etcd wal.Save order: a torn
+        # tail can then never leave a hard state referencing lost
+        # entries), then the per-peer fsync that is the durable barrier
+        # before the next dispatch.
+        if final and not par_ok:
+            for p in range(P):
+                tick_active = self._save_hard(p, pinfo) or tick_active
+            if self._ep_active:
+                for p in range(P):
+                    if self._ep_begun[p]:
+                        self.wals[p].epoch_mark(self._ep_no_this,
+                                                end=True)
+            # The durable barrier: every peer fsynced before this
+            # tick's messages can be observed (the next dispatch).  The
+            # P fsyncs are independent files — run them concurrently
+            # (os.fsync and the native wal_sync both release the GIL),
+            # so the barrier costs one fsync wall-time, not P.  A peer
+            # with nothing pending returns immediately.
+            list(self._sync_pool.map(lambda w: w.sync(), self.wals))
+        return tick_active
+
+    def _scrub_conf(self, g: int, base: int, datas: list) -> list:
+        """Blank conf entries out of a publish batch (entries at
+        base+1..): the apply plane sees an empty slot where the
+        membership change sat.  Index-driven off the scrub set — zero
+        per-entry work; `_conf_scrub[g]` is replaced (never mutated) so
+        the async publish workers can read it lock-free."""
+        scrub = self._conf_scrub[g]
+        if scrub:
+            top = base + len(datas)
+            for idx in scrub:
+                if base < idx <= top:
+                    datas[idx - base - 1] = b""
+        return datas
+
+    def _publish(self, pinfo: np.ndarray) -> None:
+        """Deliver a saved tick's newly committed entries to every
+        peer's commit stream, across ALL group shards (the inline /
+        serial-host path; the async path fans the same pinfo out to the
+        per-shard workers instead)."""
+        for shard in range(len(self._shard_groups)):
+            self._publish_shard(pinfo, shard)
+
+    def _publish_shard(self, pinfo: np.ndarray, shard: int) -> None:
+        """Deliver one group shard's newly committed entries to each
+        peer's commit stream (they were fsynced before this runs) — the
+        whole tick's block as ONE RAW_MANY queue item per peer."""
+        gsel = self._shard_groups[shard]
+        for p in range(self.cfg.num_peers):
+            col = pinfo[p]
+            commit = col[:, _C["commit"]]
+            if gsel is None:
+                ready = np.nonzero(commit > self._applied[p])[0]
+            else:
+                ready = gsel[commit[gsel] > self._applied[p][gsel]]
+            if not ready.size:
+                continue
+            if p == 0 and self.tracer is not None:
+                # Quorum/commit stamp on the client-facing stream.
+                for g, c in zip(ready.tolist(), commit[ready].tolist()):
+                    self.tracer.note_commit(g, int(c))
+            if self.publish_peers is not None \
+                    and p not in self.publish_peers:
+                # Nobody consumes this peer's stream: advance the
+                # cursor without materializing anything.
+                if p == 0:
+                    self._note_commits(int(
+                        (commit[ready] - self._applied[p][ready]).sum()))
+                self._applied[p][ready] = commit[ready]
+                continue
+            plog = self.plogs[p]
+            gl = ready.tolist()
+            cl = commit[ready].tolist()
+            al = self._applied[p][ready].tolist()
+            if p == 0 and self.native_kv is not None \
+                    and self.membership is None:
+                # C-resident apply: one call, zero Python per entry.
+                self.native_kv.apply_plog(
+                    plog.handle, gl, [a + 1 for a in al],
+                    [c - a for c, a in zip(cl, al)])
+                self._applied[p][ready] = commit[ready]
+                self._note_commits(int(
+                    (commit[ready] - np.asarray(al)).sum()))
+                continue
+            items = []
+            if hasattr(plog, "read_groups"):
+                # Native plog: every ready range in TWO ctypes calls.
+                per_range = plog.read_groups(
+                    gl, [a + 1 for a in al],
+                    [c - a for c, a in zip(cl, al)])
+                for g, a, datas in zip(gl, al, per_range):
+                    if self.membership is not None:
+                        datas = self._scrub_conf(g, a, list(datas))
+                    if any(datas):
+                        items.append((g, a, datas))
+            else:
+                sl = plog.slice
+                for g, a, c in zip(gl, al, cl):
+                    datas = sl(g, a + 1, c - a)
+                    if len(datas) != c - a:
+                        raise RuntimeError(
+                            f"peer {p} g{g}: payload log shorter than "
+                            f"commit ({a}+{len(datas)} < {c})")
+                    if self.membership is not None:
+                        datas = self._scrub_conf(g, a, datas)
+                    if any(datas):
+                        items.append((g, a, datas))
+            if items:
+                self._commit_qs[p].put((RAW_MANY, items))
+            self._applied[p][ready] = commit[ready]
+            if p == 0:
+                self._note_commits(int(
+                    (commit[ready] - np.asarray(al)).sum()))
+
+    # -- log compaction (SURVEY §5.4) -----------------------------------
+
+    def compact(self, applied: Optional[Dict[int, int]] = None,
+                keep: int = 1024) -> bool:
+        """Advance every peer's compaction floor to (applied - keep):
+        payload-log prefixes drop, COMPACT markers land in the WALs, and
+        fully-superseded closed segments unlink (storage/wal.py compact)
+        — the memory-bound story for sustained load (the reference's
+        MemoryStorage grows forever, raft.go:129).
+
+        `keep` is clamped to >= log_window so every index the device
+        ring can still reference stays servable (mirror reads and
+        in-window resends).  The publish cursor gates the floor: only
+        entries already delivered to the apply plane are dropped.
+        `applied` optionally tightens it further to the state machines'
+        DURABLY applied indexes — the calling convention RaftDB's
+        snapshot-driven compaction uses (runtime/db.py _maybe_compact),
+        so the --fused --resume --compact-every deployment works.
+        """
+        keep = max(keep, self.cfg.log_window)
+        G = self.cfg.num_groups
+        any_changed = False
+        for p in range(self.cfg.num_peers):
+            plog = self.plogs[p]
+            floors: Dict[int, Tuple[int, int]] = {}
+            changed = False
+            for g in range(G):
+                floor = int(self._applied[p][g]) - keep
+                if applied is not None:
+                    floor = min(floor, applied.get(g, 0) - keep)
+                if floor > plog.start(g):
+                    plog.compact(g, floor, plog.term_of(g, floor))
+                    changed = True
+                s = plog.start(g)
+                if s > 0:
+                    floors[g] = (s, plog.term_of(g, s))
+            if changed:
+                hard = {g: tuple(int(x) for x in self._hard[p][g])
+                        for g in range(G)}
+                self.wals[p].compact(floors, hard)
+                self.metrics.compactions += 1
+                any_changed = True
+        return any_changed
+
+    # -- teardown -------------------------------------------------------
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop_evt.set()
+            self._work_evt.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._pending_pinfo is not None:
+            self._enqueue_publish(self._pending_pinfo)  # already durable
+            self._pending_pinfo = None
+        for q in self._pub_qs:
+            q.put(None)                       # drain, then retire
+        for th in self._pub_threads:
+            th.join(timeout=10)
+        self._sync_pool.shutdown(wait=True)
+        if self._epoch_f is not None:
+            self._epoch_f.close()
+            self._epoch_f = None
+        for w in self.wals:
+            w.close()
+        for plog in self.plogs:
+            if hasattr(plog, "close"):
+                plog.close()
+        for q in self._commit_qs:
+            q.put(CLOSED)
+
+    # -- introspection (tests) -----------------------------------------
+
+    def roles(self) -> np.ndarray:
+        """[P, G] role matrix from the live device state."""
+        return np.asarray(self.states.role)

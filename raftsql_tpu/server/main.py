@@ -127,6 +127,57 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                   compact_every=compact_every, compact_keep=compact_keep)
 
 
+def build_mesh_node(groups: int = 8, peers: int = 3,
+                    tick: float = 0.002,
+                    data_prefix: str = "raftsql",
+                    group_shards: int = 0, peer_shards: int = 1,
+                    resume: bool = False,
+                    compact_every: int = 0, compact_keep: int = 1024,
+                    wal_segment_bytes: int = 4 << 20,
+                    trace: bool = False) -> RaftDB:
+    """The --mesh deployment (runtime/mesh.py): the fused cluster with
+    its consensus step SPMD over a real device mesh — G sharded over
+    the `groups` axis — and the durable host plane sharded to match:
+    per-shard WAL dirs under <prefix>-mesh/p<i>/s<j>, per-shard publish
+    workers, and the SQLite state machines laid out per group shard
+    under <prefix>-mesh-db/s<j>/.  `group_shards=0` auto-picks the
+    widest mesh the visible devices allow (on a dev box: force
+    devices with XLA_FLAGS=--xla_force_host_platform_device_count=8
+    JAX_PLATFORMS=cpu)."""
+    import os as _os
+
+    from raftsql_tpu.runtime.fused import FusedPipe
+    from raftsql_tpu.runtime.mesh import MeshClusterNode, MeshConfig
+
+    cfg = RaftConfig(num_groups=groups, num_peers=peers,
+                     tick_interval_s=tick,
+                     wal_segment_bytes=wal_segment_bytes)
+    mc = (MeshConfig.for_groups(cfg, peer_shards=peer_shards)
+          if group_shards <= 0
+          else MeshConfig(peer_shards=peer_shards,
+                          group_shards=group_shards))
+    mc.validate(cfg)
+    logging.getLogger("raftsql.server").info(
+        "mesh deployment: %dx%d devices, %d groups (%d per shard)",
+        mc.peer_shards, mc.group_shards, groups,
+        groups // mc.group_shards)
+    node = MeshClusterNode(cfg, f"{data_prefix}-mesh", mc.build())
+    if trace:
+        node.enable_tracing()
+    node.start(interval_s=max(tick, 0.0005))
+    pipe = FusedPipe(node)
+    g_loc = groups // mc.group_shards
+
+    def sm_factory(g: int) -> SQLiteStateMachine:
+        d = f"{data_prefix}-mesh-db/s{g // g_loc}"
+        _os.makedirs(d, exist_ok=True)
+        return SQLiteStateMachine(_os.path.join(d, f"g{g}.db"),
+                                  resume=resume)
+
+    return RaftDB(sm_factory, pipe, num_groups=groups, resume=resume,
+                  compact_every=compact_every, compact_keep=compact_keep)
+
+
 # Exit code when the consensus engine dies of a fatal error (failed
 # fsync, injected ENOSPC, transport teardown): the etcd posture — a
 # server that can no longer participate must CRASH, visibly, rather
@@ -221,7 +272,18 @@ def main(argv=None) -> None:
                          "peers co-located on one device, one fused "
                          "step per tick (no --cluster/--id needed)")
     ap.add_argument("--peers", type=int, default=3,
-                    help="with --fused: peers per group")
+                    help="with --fused/--mesh: peers per group")
+    ap.add_argument("--mesh", action="store_true",
+                    help="single-process cluster SPMD over a device "
+                         "MESH (runtime/mesh.py): G sharded over the "
+                         "'groups' axis, per-shard WAL dirs + publish "
+                         "workers + SQLite shards (no --cluster/--id)")
+    ap.add_argument("--group-shards", type=int, default=0,
+                    help="with --mesh: devices on the groups axis "
+                         "(0 = widest fit for the visible devices)")
+    ap.add_argument("--peer-shards", type=int, default=1,
+                    help="with --mesh: devices on the peers axis (the "
+                         "message exchange then rides all_to_all)")
     ap.add_argument("--http-engine", choices=("aio", "threaded"),
                     default="aio",
                     help="HTTP plane: single-thread event loop with "
@@ -257,7 +319,17 @@ def main(argv=None) -> None:
     # (runtime/node.py _run; SURVEY.md §5.1 — host-side profiling of
     # the serving process, the complement of the JAX profiler's device
     # traces in bench.py).
-    if args.fused:
+    if args.mesh:
+        rdb = build_mesh_node(groups=args.groups, peers=args.peers,
+                              tick=args.tick,
+                              group_shards=args.group_shards,
+                              peer_shards=args.peer_shards,
+                              resume=args.resume,
+                              compact_every=args.compact_every,
+                              compact_keep=args.compact_keep,
+                              wal_segment_bytes=args.wal_segment_bytes,
+                              trace=args.trace)
+    elif args.fused:
         rdb = build_fused_node(groups=args.groups, peers=args.peers,
                                tick=args.tick, resume=args.resume,
                                compact_every=args.compact_every,

@@ -8,6 +8,7 @@ through the commit-listener nil-sentinel protocol (db.go:26, 48-50).
 """
 import os
 import queue
+import sqlite3
 import threading
 import time
 
@@ -318,7 +319,13 @@ def test_follower_catchup_beyond_ring_window(tmp_path):
         dbs[1] = boot(1)
         deadline = time.monotonic() + TIMEOUT
         while True:
-            v = dbs[1].query("SELECT count(*) from main.t")
+            # The restarted replica may not have replayed/caught up the
+            # CREATE yet: local reads are stale by design, so "no such
+            # table" is a legitimate transient — keep polling.
+            try:
+                v = dbs[1].query("SELECT count(*) from main.t")
+            except sqlite3.OperationalError as e:
+                v = repr(e)
             if v == f"|{3 * cfg.log_window}|\n":
                 break
             assert time.monotonic() < deadline, \
@@ -394,7 +401,15 @@ def test_follower_catchup_below_table_floor(tmp_path):
         dbs[2] = boot(2)
         deadline = time.monotonic() + TIMEOUT
         while True:
-            v = dbs[2].query("SELECT count(*) from main.t")
+            # "no such table" is a legitimate transient on the freshly
+            # restarted replica (stale local reads by design): its
+            # parity-mode SQLite was rebuilt from a replayed prefix
+            # that may predate the CREATE — poll until catch-up
+            # delivers it.
+            try:
+                v = dbs[2].query("SELECT count(*) from main.t")
+            except sqlite3.OperationalError as e:
+                v = repr(e)
             if v == f"|{inserted}|\n":
                 break
             assert time.monotonic() < deadline, \

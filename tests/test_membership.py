@@ -303,22 +303,12 @@ def test_config_initial_voters_validation():
         RaftConfig(num_peers=4, initial_voters=(1, 1))
 
 
-# -- mesh lockstep regression (ROADMAP frontier note) ------------------
-
-def test_mesh_skew_raises_typed_lockstep_error():
-    """MeshClusterNode ticks lockstep only: a skew request must raise
-    the TYPED error naming the limitation and the way out — not a bare
-    NotImplementedError, and never a silent ignore."""
-    from raftsql_tpu.parallel.sharded import MeshLockstepOnlyError
-    from raftsql_tpu.runtime.fused import MeshClusterNode
-
-    node = object.__new__(MeshClusterNode)   # guard fires before state
-    with pytest.raises(MeshLockstepOnlyError) as ei:
-        node._device_step(np.zeros(2, np.int64),
-                          timer_inc=np.ones(3, np.int32))
-    assert isinstance(ei.value, NotImplementedError)
-    msg = str(ei.value)
-    assert "lockstep" in msg and "FusedClusterNode" in msg
+# The PR-4 "mesh ticks lockstep only" regression test
+# (MeshLockstepOnlyError) is gone with the error itself: the mesh
+# runtime now takes the per-peer timer vector through the sharded step
+# (parallel/sharded.py timer_spec).  Skew-on-mesh coverage lives in
+# tests/test_mesh.py (lockstep vs skewed elections diverge; mesh-skew
+# chaos family digests reproduce) and `make chaos-mesh`.
 
 
 # -- fused runtime lifecycle -------------------------------------------

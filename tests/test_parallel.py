@@ -171,7 +171,7 @@ def test_mesh_cluster_node_durable(tmp_path):
     every peer's WAL is written, and a restart replays them over the
     same mesh (VERDICT r4 task 5 / SURVEY §7 phase 4)."""
     from raftsql_tpu.runtime.db import _expand_commit_item
-    from raftsql_tpu.runtime.fused import MeshClusterNode
+    from raftsql_tpu.runtime.mesh import MeshClusterNode
 
     cfg = RaftConfig(num_groups=8, num_peers=4, log_window=32,
                      max_entries_per_msg=4, tick_interval_s=0.0)
@@ -203,10 +203,13 @@ def test_mesh_cluster_node_durable(tmp_path):
     live = drain(node)
     assert len(live) == 8 * 5
     node.stop()
-    # Every peer's WAL dir holds segments (durability actually happened).
+    # Every peer's WAL is sharded per group shard (runtime/mesh.py
+    # ShardedWAL: p<i>/s<j>) and every shard dir holds segments —
+    # durability actually happened, laid out per local device shard.
     for p in range(4):
-        segs = list((tmp_path / f"p{p + 1}").iterdir())
-        assert segs, f"peer {p} wrote no WAL"
+        for j in range(4):
+            segs = list((tmp_path / f"p{p + 1}" / f"s{j}").glob("wal-*"))
+            assert segs, f"peer {p} shard {j} wrote no WAL"
 
     node2 = MeshClusterNode(cfg, str(tmp_path), mesh)
     rep = drain(node2)
