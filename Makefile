@@ -20,6 +20,20 @@ native:
 	          lib = load_native_wal(); \
 	          print('native wal:', 'ok' if lib else 'UNAVAILABLE')"
 
+# Build-check the native GROUP-COMMIT path (wal.cc walplog_* group
+# bias): write through per-peer views of one shared native WAL, replay,
+# and assert the per-peer split round-trips.  Fails if the toolchain is
+# present but the group-commit ABI is broken; degrades to a SKIP where
+# no compiler exists (the Python backend covers those hosts).
+native-check:
+	$(PY) scripts/check_native_gc.py
+
+# Serving smoke (scripts/serving_smoke.py): a --fused --workers 2
+# deployment driven by the native loadgen; asserts zero errors and a
+# req/s floor.  SMOKE_SECONDS/SMOKE_CLIENTS/SMOKE_MIN_RPS override.
+serving-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/serving_smoke.py
+
 # make test captures output like the reference (Makefile:10-15).
 test:
 	$(PY) -m pytest tests/ -q 2>&1 | tee test.out

@@ -711,7 +711,8 @@ def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
 
 
 def bench_http(groups: int, seconds: float, clients: int,
-               fused: bool = False, device: bool = False):
+               fused: bool = False, device: bool = False,
+               workers: int = 0):
     """BASELINE config 1: the real cluster driven over HTTP.
 
     The reference's observable unit of work is HTTP PUT -> 204 after
@@ -766,7 +767,8 @@ def bench_http(groups: int, seconds: float, clients: int,
                 [sys.executable, "-m", "raftsql_tpu.server.main",
                  "--fused", "--port", str(api_ports[0]),
                  "--groups", str(groups), "--tick", tick,
-                 "--http-engine", engine],
+                 "--http-engine", engine]
+                + (["--workers", str(workers)] if workers else []),
                 cwd=tmp, env=env, stdout=logf, stderr=logf))
         else:
             for i in range(3):
@@ -1069,7 +1071,13 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int,
              f"shard), per-shard WAL dirs + publish workers")
         node = MeshClusterNode(cfg, tmp, mesh)
     else:
-        node = FusedClusterNode(cfg, tmp)
+        # WAL group commit (PR 7): one shared log + one fsync per tick
+        # for all P peers — the durable rung's default; 0 restores the
+        # per-peer-file layout for A/Bs.
+        node = FusedClusterNode(
+            cfg, tmp,
+            group_commit=os.environ.get(
+                "BENCH_WAL_GROUP_COMMIT", "1") == "1")
     node.publish_peers = {0}       # the drain consumes peer 0's stream
     kv_native = None
     if native_apply and not hasattr(node.plogs[0], "handle"):
@@ -1221,7 +1229,16 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int,
                "durable_phase_overlap": overlapped,
                "durable_tick_ms": round(tick_ms, 3),
                "durable_lat": lat_stats,
-               "repeat_rates": repeat_rates}
+               "repeat_rates": repeat_rates,
+               # Serving-stack levers (PR 7): double-buffered dispatch
+               # engagement + the group-commit batch-size histogram
+               # (peers coalesced per fsync -> count).
+               "overlap_ticks": node.metrics.overlap_ticks}
+        gcw = getattr(node, "_gcwal", None)
+        if gcw is not None:
+            out["wal_group_commits"] = gcw.group_commits
+            out["wal_gc_batch_hist"] = {
+                str(k): v for k, v in sorted(gcw.batch_hist.items())}
         if mesh_cfg is not None:
             out["mesh_group_shards"] = mesh_cfg.group_shards
             out["mesh_groups"] = groups
@@ -1365,27 +1382,42 @@ def run_config(config: str, cpu: bool):
         # Further rungs, best-effort: high concurrency on the 3-process
         # cluster, then the --fused single-process deployment (the
         # TPU-native shape) at both client counts.
-        rungs = [("http_lat_hi", chi, False, False),
-                 ("http_lat_fused", c16, True, False),
-                 ("http_lat_fused_hi", chi, True, False)]
+        rungs = [("http_lat_hi", chi, False, False, 0),
+                 ("http_lat_fused", c16, True, False, 0),
+                 ("http_lat_fused_hi", chi, True, False, 0)]
+        # Multi-worker serving ladder (PR 7, runtime/ring.py): the
+        # fused engine behind 1/2/4/8 SO_REUSEPORT HTTP worker
+        # processes at high concurrency — the req/s-vs-workers scaling
+        # story.  BENCH_HTTP_WORKERS_LADDER= (empty) skips it.
+        for w in (int(x) for x in os.environ.get(
+                "BENCH_HTTP_WORKERS_LADDER", "1,2,4,8").split(",")
+                if x):
+            rungs.append((f"http_workers_{w}", chi, True, False, w))
         if os.environ.get("BENCH_HTTP_DEVICE") == "1":
             # config-1 ON THE DEVICE: the fused server inherits the
             # session platform (the chip via the tunnel), the full
             # HTTP -> device step -> WAL -> SQLite -> 204 stack.
             rungs.append(("http_lat_fused_tpu",
                           int(os.environ.get("BENCH_HTTP_CLIENTS_TPU",
-                                             "192")), True, True))
-        for key, clients, fused, device in rungs:
+                                             "192")), True, True, 0))
+        ladder: dict = {}
+        for key, clients, fused, device, workers in rungs:
             if clients <= 0:
                 continue
             try:
                 r, ex = bench_http(g, secs, clients, fused=fused,
-                                   device=device)
+                                   device=device, workers=workers)
                 best = max(best, r)
                 extras[key] = ex["http_lat"]
+                if workers:
+                    ladder[str(workers)] = round(r, 1)
             except Exception as e:                  # noqa: BLE001
                 _log(f"  http rung {key} FAILED: {e}")
                 extras[key] = {"error": str(e)}
+                if workers:
+                    ladder[str(workers)] = f"fault: {e}"
+        if ladder:
+            extras["http_workers_ladder"] = ladder
         return best, extras
     if config == "durable":
         # sqlite keeps one DB file (3 fds with -wal/-shm) per group: stay

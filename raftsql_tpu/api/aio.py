@@ -341,11 +341,15 @@ class AioSQLServer:
     shape, same start()/stop() lifecycle, one event-loop thread."""
 
     def __init__(self, port: int, rdb: RaftDB, host: str = "",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, reuse_port: bool = False):
         self.port = port
         self.rdb = rdb
         self.host = host
         self.timeout_s = timeout_s
+        # SO_REUSEPORT: N worker processes bind the SAME port and the
+        # kernel load-balances accepted connections across them — the
+        # multi-worker serving plane (runtime/ring.py, --workers N).
+        self.reuse_port = reuse_port
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.bridge: Optional[_AckBridge] = None
         self._thread: Optional[threading.Thread] = None
@@ -361,7 +365,8 @@ class AioSQLServer:
         self.bridge = _AckBridge(self.loop)
         self._server = await self.loop.create_server(
             lambda: _Conn(self), self.host or None, self.port,
-            backlog=256, reuse_address=True)
+            backlog=256, reuse_address=True,
+            reuse_port=self.reuse_port or None)
         if self.port == 0:      # tests bind port 0 and read it back
             self.port = self._server.sockets[0].getsockname()[1]
         self._started.set()

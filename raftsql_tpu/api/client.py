@@ -59,14 +59,85 @@ _RETRYABLE_OS = (ConnectionRefusedError, ConnectionResetError,
                  BrokenPipeError, socket.timeout, TimeoutError, OSError)
 
 
+class _NodePool:
+    """Keep-alive connection pool for ONE node: bounded concurrency
+    (the semaphore is the per-node in-flight cap — a loadgen with 500
+    threads cannot open 500 sockets to one server), idle connections
+    reused LIFO (warmest first).  http.client connections are not
+    thread-safe; each is owned by exactly one borrower at a time."""
+
+    def __init__(self, host: str, port: int, max_conns: int,
+                 max_idle: int):
+        import threading
+        self.host, self.port = host, port
+        self._idle: List[http.client.HTTPConnection] = []
+        self._mu = threading.Lock()
+        self._sem = threading.BoundedSemaphore(max_conns)
+        self._max_idle = max_idle
+
+    def acquire(self, timeout_s: float):
+        """(conn, reused): a pooled keep-alive connection when one is
+        idle, else a fresh one.  Blocks while the node is at its
+        concurrency cap."""
+        if not self._sem.acquire(timeout=timeout_s):
+            raise socket.timeout(
+                f"{self.host}:{self.port}: per-node concurrency cap")
+        with self._mu:
+            conn = self._idle.pop() if self._idle else None
+        if conn is not None:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            return conn, True
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=timeout_s), False
+
+    def release(self, conn, keep: bool) -> None:
+        if keep:
+            with self._mu:
+                if len(self._idle) < self._max_idle:
+                    self._idle.append(conn)
+                    conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:          # pragma: no cover - teardown race
+                pass
+        self._sem.release()
+
+    def close(self) -> None:
+        with self._mu:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            try:
+                c.close()
+            except OSError:          # pragma: no cover - teardown race
+                pass
+
+
 class RaftSQLClient:
     """Client for one cluster: `nodes` is a list of "host:port" (or
     bare port numbers, meaning localhost) client-API endpoints, indexed
-    the way the caller thinks of node ids (0-based)."""
+    the way the caller thinks of node ids (0-based).
+
+    Connection handling is a load-balancing POOL (PR 7): keep-alive
+    connections per node reused across requests (the old one-connection
+    -per-request shape spent most of a small PUT's budget on TCP
+    setup/teardown), per-node in-flight caps, and a leader cache + RR
+    cursor shared THREAD-SAFELY across every thread using this client
+    — a bench loadgen drives one client object from hundreds of
+    workers.  A request that fails on a REUSED connection (the server
+    closed the idle socket) transparently retries once on a fresh
+    connection before surfacing the error; fresh-connection failures
+    surface unchanged, so the callers' retry policies see exactly the
+    old contract."""
 
     def __init__(self, nodes: List, timeout_s: float = 10.0,
                  backoff_s: float = 0.05, backoff_cap_s: float = 1.0,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 max_conns_per_node: int = 64,
+                 max_idle_per_node: int = 32):
+        import threading
         self.nodes: List[Tuple[str, int]] = []
         for n in nodes:
             if isinstance(n, int):
@@ -78,28 +149,53 @@ class RaftSQLClient:
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
         self._rng = rng or random.Random()
+        self._mu = threading.Lock()            # leader cache + rr cursor
         self._leader: Dict[int, int] = {}      # group -> node index
         self._rr = 0                           # round-robin cursor
+        self._pools = [_NodePool(h, p, max_conns_per_node,
+                                 max_idle_per_node)
+                       for (h, p) in self.nodes]
+
+    def close(self) -> None:
+        for p in self._pools:
+            p.close()
 
     # -- low-level -----------------------------------------------------
 
     def raw(self, node: int, method: str, path: str = "/",
             body: str = "", headers: Optional[dict] = None,
             timeout_s: Optional[float] = None):
-        """One request to one node, no retries: (status, headers, text).
-        Raises the underlying OSError on connection trouble — the retry
-        policy lives in the callers."""
-        host, port = self.nodes[node]
-        conn = http.client.HTTPConnection(
-            host, port, timeout=timeout_s or self.timeout_s)
-        try:
-            conn.request(method, path, body=body.encode("utf-8"),
-                         headers=headers or {})
-            r = conn.getresponse()
-            return r.status, dict(r.getheaders()), r.read().decode(
-                "utf-8", "replace")
-        finally:
-            conn.close()
+        """One request to one node, no cluster-level retries:
+        (status, headers, text).  Raises the underlying OSError on
+        connection trouble — the retry policy lives in the callers.
+        (A stale KEEP-ALIVE socket is retried once on a fresh
+        connection internally; that is connection reuse mechanics, not
+        policy.)"""
+        t = timeout_s or self.timeout_s
+        pool = self._pools[node]
+        for attempt in (0, 1):
+            conn, reused = pool.acquire(t)
+            keep = False
+            try:
+                conn.request(method, path, body=body.encode("utf-8"),
+                             headers=headers or {})
+                r = conn.getresponse()
+                text = r.read().decode("utf-8", "replace")
+                keep = not r.will_close
+                return r.status, dict(r.getheaders()), text
+            except _RETRYABLE_OS:
+                if reused and attempt == 0:
+                    continue           # stale keep-alive: one fresh try
+                raise
+            except http.client.HTTPException as e:
+                # A half-closed keep-alive socket surfaces as
+                # BadStatusLine/RemoteDisconnected, not OSError.
+                if reused and attempt == 0:
+                    continue
+                raise ConnectionResetError(str(e)) from e
+            finally:
+                pool.release(conn, keep)
+        raise AssertionError("unreachable")    # pragma: no cover
 
     def _order(self, group: int, node: Optional[int]) -> List[int]:
         """Attempt order: pinned node only, else cached leader first,
@@ -107,10 +203,11 @@ class RaftSQLClient:
         if node is not None:
             return [node]
         n = len(self.nodes)
-        start = self._rr % n
-        self._rr += 1
+        with self._mu:
+            start = self._rr % n
+            self._rr += 1
+            lead = self._leader.get(group)
         order = [(start + i) % n for i in range(n)]
-        lead = self._leader.get(group)
         if lead is not None and lead in order:
             order.remove(lead)
             order.insert(0, lead)
@@ -119,7 +216,8 @@ class RaftSQLClient:
     def _note_leader(self, group: int, headers: dict) -> bool:
         hint = headers.get("X-Raft-Leader")
         if hint and hint.isdigit() and int(hint) > 0:
-            self._leader[group] = (int(hint) - 1) % len(self.nodes)
+            with self._mu:
+                self._leader[group] = (int(hint) - 1) % len(self.nodes)
             return True
         return False
 

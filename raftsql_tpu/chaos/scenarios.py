@@ -426,6 +426,13 @@ class FusedChaosRunner:
                     self.node.timer_inc = ti
                     try:
                         self.node.tick()
+                        # With double-buffered dispatch (hostplane
+                        # overlap) the tick's durable phase is stashed;
+                        # this drain retires it, so the injected
+                        # storage faults fire HERE — same ops, same
+                        # order, same crash posture as the serialized
+                        # pipeline (digests must not move).
+                        self.node.publish_flush()
                     except fsio.EnospcError:
                         # Disk full on a WAL append: the tick's durable
                         # barrier cannot complete, so this is fatal
@@ -452,7 +459,6 @@ class FusedChaosRunner:
                         self._crash_restart(t, power_loss=True,
                                             tear_peer=int(e.tag))
                         continue
-                    self.node.publish_flush()
                     for (g, base, datas) in _drain_fused_q(
                             self.node.commit_q(0)):
                         for off, d in enumerate(datas):

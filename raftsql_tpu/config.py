@@ -83,6 +83,19 @@ class RaftConfig:
     # between slots, they never resize P.
     initial_voters: "tuple | None" = None
 
+    # Membership masks may CHANGE at runtime (a conf entry has applied,
+    # or could).  While False with initial_voters=None, the step takes
+    # the STATIC full-voter fast path: the per-group [G, P] voter masks
+    # are known constants, so the mask-weighted quorum kernels collapse
+    # back to the fixed-quorum forms (one sort + static gather instead
+    # of two masked sorts + one-hot selects, no mask gates on vote
+    # grants).  The masked kernels with a full mask are bit-identical
+    # (property-tested), so the runtimes flip this lazily — the moment
+    # a conf entry is restored/applied/enabled — at the cost of one
+    # recompile, and the static-cluster hot path pays nothing for the
+    # membership subsystem.
+    dynamic_membership: bool = False
+
     # Timing, in ticks (one device step == one tick).
     election_ticks: int = 10     # min randomized election timeout
     heartbeat_ticks: int = 1     # leader heartbeat period
@@ -170,3 +183,10 @@ class RaftConfig:
     @property
     def quorum(self) -> int:
         return self.num_peers // 2 + 1
+
+    @property
+    def static_full_voters(self) -> bool:
+        """True when every peer slot is a voter AND that cannot change:
+        the step may then use the fixed-quorum kernels (see
+        dynamic_membership)."""
+        return self.initial_voters is None and not self.dynamic_membership

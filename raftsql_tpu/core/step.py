@@ -40,8 +40,9 @@ from raftsql_tpu.config import (CANDIDATE, FLOOR_HINT_BIAS, FOLLOWER, LEADER,
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
-from raftsql_tpu.ops.quorum import masked_quorum_commit_index, \
-    masked_vote_win
+from raftsql_tpu.ops.quorum import (masked_quorum_commit_index,
+                                    masked_vote_win, quorum_commit_index,
+                                    vote_count)
 
 
 def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
@@ -99,9 +100,28 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # cfg.quorum math bit for bit.  `voter_src[g, p]` = slot p is a
     # voter of group g under EITHER mask (joint consensus counts both);
     # `self_voter[g]` = this peer may campaign.
+    #
+    # STATIC fast path (cfg.static_full_voters): the masks are known
+    # full constants, so every mask gate folds to identity and every
+    # quorum is the fixed-threshold kernel — the pre-membership program.
+    # The masked kernels with a full mask are bit-identical (property-
+    # tested in tests/test_membership.py), so the two paths may never
+    # diverge; runtimes flip to the dynamic path (one recompile) the
+    # moment a conf entry exists (config.py dynamic_membership).
     voters, jvoters = state.voters, state.voters_joint
-    voter_src = voters | jvoters                                 # [G, P]
-    self_voter = jnp.sum(voter_src & self_onehot, axis=-1) > 0   # [G]
+    if cfg.static_full_voters:
+        voter_src = True           # folds out of every & below
+        self_voter = True
+
+        def _vote_win(votes):
+            return vote_count(votes) >= cfg.quorum
+    else:
+        voter_src = voters | jvoters                             # [G, P]
+        self_voter = jnp.sum(voter_src & self_onehot,
+                             axis=-1) > 0                        # [G]
+
+        def _vote_win(votes):
+            return masked_vote_win(votes, voters, jvoters)
 
     log_term, log_len = state.log_term, state.log_len
     tbl_pos, tbl_term = state.tbl_pos, state.tbl_term
@@ -187,8 +207,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
             & (inbox.v_term == term[:, None] + 1) \
             & (role == PRECANDIDATE)[:, None]
         votes = votes | got_pre
-        become_cand = (role == PRECANDIDATE) \
-            & masked_vote_win(votes, voters, jvoters)
+        become_cand = (role == PRECANDIDATE) & _vote_win(votes)
         term = jnp.where(become_cand, term + 1, term)
         role = jnp.where(become_cand, CANDIDATE, role)
         voted = jnp.where(become_cand, self_id, voted)
@@ -198,8 +217,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     got_vote = (inbox.v_type == MSG_RESP) & (inbox.v_term == term[:, None]) \
         & inbox.v_granted & (role == CANDIDATE)[:, None]
     votes = votes | got_vote
-    become_leader = (role == CANDIDATE) \
-        & masked_vote_win(votes, voters, jvoters)
+    become_leader = (role == CANDIDATE) & _vote_win(votes)
     role = jnp.where(become_leader, LEADER, role)
     leader_hint = jnp.where(become_leader, self_id, leader_hint)
     next_idx = jnp.where(become_leader[:, None], log_len[:, None] + 1,
@@ -401,17 +419,34 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # (selected by cfg.commit_rule; all implement raft Fig. 2's leader
     # rule, see ops/commit_scan.py and ops/pallas_quorum.py).
     if cfg.commit_rule == "windowed":
-        from raftsql_tpu.ops.commit_scan import \
-            masked_windowed_commit_index
-        commit = masked_windowed_commit_index(
-            match, log_term, log_len, commit, term, is_leader,
-            voters=voters, voters_joint=jvoters, window=W)
+        if cfg.static_full_voters:
+            from raftsql_tpu.ops.commit_scan import windowed_commit_index
+            commit = windowed_commit_index(
+                match, log_term, log_len, commit, term, is_leader,
+                quorum=cfg.quorum, window=W)
+        else:
+            from raftsql_tpu.ops.commit_scan import \
+                masked_windowed_commit_index
+            commit = masked_windowed_commit_index(
+                match, log_term, log_len, commit, term, is_leader,
+                voters=voters, voters_joint=jvoters, window=W)
     elif cfg.commit_rule == "pallas":
-        from raftsql_tpu.ops.pallas_quorum import \
-            pallas_masked_quorum_commit_index
-        commit = pallas_masked_quorum_commit_index(
+        if cfg.static_full_voters:
+            from raftsql_tpu.ops.pallas_quorum import \
+                pallas_quorum_commit_index
+            commit = pallas_quorum_commit_index(
+                match, log_term, log_len, commit, term, is_leader,
+                quorum=cfg.quorum, window=W)
+        else:
+            from raftsql_tpu.ops.pallas_quorum import \
+                pallas_masked_quorum_commit_index
+            commit = pallas_masked_quorum_commit_index(
+                match, log_term, log_len, commit, term, is_leader,
+                voters=voters, voters_joint=jvoters, window=W)
+    elif cfg.static_full_voters:
+        commit = quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            voters=voters, voters_joint=jvoters, window=W)
+            quorum=cfg.quorum, window=W, term_of=term_of1)
     else:
         commit = masked_quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,

@@ -152,14 +152,14 @@ def load_native_plog():
             c.c_void_p, c.c_void_p, c.c_uint32,
             c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
-            c.c_char_p, c.POINTER(c.c_uint32)]
+            c.c_char_p, c.POINTER(c.c_uint32), c.c_uint32]
         lib.walplog_mirror_all.restype = c.c_int
         lib.walplog_mirror_all.argtypes = [
             c.POINTER(c.c_void_p), c.POINTER(c.c_void_p), c.c_uint32,
             c.POINTER(c.c_uint32), c.POINTER(c.c_uint32),
             c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint32), c.POINTER(c.c_int64),
-            c.POINTER(c.c_uint64)]
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint32)]
         lib.kv_new.restype = c.c_void_p
         lib.kv_new.argtypes = [c.c_uint32]
         lib.kv_free.restype = None

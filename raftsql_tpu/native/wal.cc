@@ -569,10 +569,17 @@ int plog_read_groups(void* h, uint32_t n_ranges, const uint32_t* groups,
 // AND the payload-log range, all entries of range i sharing terms[i].
 // Ranges are (group, start, count) with payload bytes concatenated in
 // `blob` / per-entry `lens` in range order.  One call per peer per tick.
+//
+// `wal_group_bias` is added to the group id of every WAL record (NOT
+// the payload-log index): the group-commit layout (storage/wal.py
+// GroupCommitWAL) multiplexes all P peers' logical logs into one
+// physical record stream by flat id peer*G + g, while each peer's
+// payload log stays per-peer and unbiased.
 int walplog_put_uniform(void* wal_h, void* plog_h, uint32_t n_ranges,
                         const uint32_t* groups, const uint64_t* starts,
                         const uint32_t* counts, const uint64_t* terms,
-                        const uint8_t* blob, const uint32_t* lens) {
+                        const uint8_t* blob, const uint32_t* lens,
+                        uint32_t wal_group_bias) {
   Wal* w = static_cast<Wal*>(wal_h);
   Plog* p = static_cast<Plog*>(plog_h);
   std::lock_guard<std::mutex> lw(w->mu);
@@ -586,8 +593,8 @@ int walplog_put_uniform(void* wal_h, void* plog_h, uint32_t n_ranges,
     tbuf.assign(n, terms[r]);
     size_t range_bytes = 0;
     for (uint32_t i = 0; i < n; ++i) range_bytes += lens[li + i];
-    wal_range_locked(w, body, groups[r], starts[r], terms[r], n,
-                     lens + li, blob + off, range_bytes);
+    wal_range_locked(w, body, groups[r] + wal_group_bias, starts[r],
+                     terms[r], n, lens + li, blob + off, range_bytes);
     int rc = plog_put_locked(p->groups[groups[r]], starts[r], n,
                              tbuf.data(), blob + off, lens + li, -1);
     if (rc != 0) return rc;
@@ -603,11 +610,17 @@ int walplog_put_uniform(void* wal_h, void* plog_h, uint32_t n_ranges,
 // contract); phase B writes each destination's payload-log range +
 // truncation and its WAL ENTRY records.  `wals`/`plogs` are per-peer
 // handle arrays; `peer`/`src` index them.
+// `wal_biases` (may be null = all zero) is indexed by destination peer
+// and added to the group id of that peer's WAL records only — see
+// walplog_put_uniform.  Under the group-commit layout every wals[i]
+// is the SAME shared handle (one buffer, one mutex, one fd) and the
+// bias keeps the multiplexed records per-peer-separable on replay.
 int walplog_mirror_all(void** wals, void** plogs, uint32_t n_mirrors,
                        const uint32_t* peer, const uint32_t* src,
                        const uint32_t* groups, const uint64_t* starts,
                        const uint32_t* counts, const int64_t* new_lens,
-                       uint64_t* per_peer_bytes) {
+                       uint64_t* per_peer_bytes,
+                       const uint32_t* wal_biases) {
   struct Scratch {
     std::vector<std::string> datas;
     std::vector<uint64_t> terms;
@@ -639,12 +652,13 @@ int walplog_mirror_all(void** wals, void** plogs, uint32_t n_mirrors,
     // WAL records as same-term RANGE runs (split at term boundaries —
     // rare: only elections change terms inside a mirrored batch),
     // gather-framed so each payload byte is copied once.
+    uint32_t bias = wal_biases ? wal_biases[peer[i]] : 0;
     for (uint32_t k0 = 0; k0 < n;) {
       uint64_t t = scratch[i].terms[k0];
       uint32_t k1 = k0;
       while (k1 < n && scratch[i].terms[k1] == t) ++k1;
-      wal_range_gather_locked(w, body, groups[i], starts[i] + k0, t,
-                              scratch[i].datas.data(), k0, k1);
+      wal_range_gather_locked(w, body, groups[i] + bias, starts[i] + k0,
+                              t, scratch[i].datas.data(), k0, k1);
       k0 = k1;
     }
     for (uint32_t k = 0; k < n; ++k) {

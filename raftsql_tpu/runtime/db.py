@@ -224,6 +224,10 @@ class RaftDB:
         self._failed: Optional[Exception] = None
         self._closed = False
         self.latency = LatencyTimer()   # propose→ack, the p50 north star
+        # Serving-plane gauge hook (runtime/ring.py RingServer): a
+        # callable whose dict is merged into metrics() — ring depth,
+        # proposed/completed counts of the multi-worker deployment.
+        self.serving_metrics = None
         # propose→commit (stamped when the committed entry reaches the
         # apply consumer — commit + publish, before apply): the
         # histogram /metrics exports as propose_commit_p50/p95/p99_ms.
@@ -552,6 +556,11 @@ class RaftDB:
             v, l = node.cfg.num_peers * node.cfg.num_groups, 0
         m["members_voters"] = v
         m["members_learners"] = l
+        if self.serving_metrics is not None:
+            try:
+                m.update(self.serving_metrics())
+            except Exception:                           # noqa: BLE001
+                pass        # a gauge must never break the scrape
         return m
 
     def render_metrics(self) -> str:

@@ -129,12 +129,41 @@ class ClusterHostPlane:
     # last record matters — see _commit_epoch).
     _EPOCH_ROTATE_BYTES = 1 << 20
 
+    # WAL group commit (storage/wal.py GroupCommitWAL) is a per-data-dir
+    # layout choice; the mesh runtime's ShardedWAL seams supersede it.
+    supports_group_commit = True
+
     def __init__(self, cfg: RaftConfig, data_dir: str,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 group_commit: Optional[bool] = None):
         P, G = cfg.num_peers, cfg.num_groups
         self.cfg = cfg
         self.metrics = NodeMetrics()
         self.dirs = [os.path.join(data_dir, f"p{i + 1}") for i in range(P)]
+        # WAL group commit: multiplex all P peers' records into ONE
+        # physical log (flat group id peer*G+g) so the durable barrier
+        # is one write+fsync per tick instead of P fsyncs in flight.
+        # None = env RAFTSQL_WAL_GROUP_COMMIT (the serving deployment
+        # and the durable bench turn it on); an existing per-peer
+        # layout wins over the flag — never mix layouts in one dir.
+        if group_commit is None:
+            group_commit = os.environ.get(
+                "RAFTSQL_WAL_GROUP_COMMIT") == "1"
+        self._gc_dir = os.path.join(data_dir, "gc")
+        self._gcwal = None
+        self._gc_mode = False
+        self._gc_replay: Optional[dict] = None
+        self._gc_repaired = False
+        if group_commit and self.supports_group_commit:
+            from raftsql_tpu.storage.wal import GroupCommitWAL
+            legacy = any(wal_exists(d) for d in self.dirs)
+            if legacy and not GroupCommitWAL.exists(self._gc_dir):
+                import logging
+                logging.getLogger("raftsql.hostplane").warning(
+                    "%s: per-peer WAL layout exists; group commit "
+                    "disabled for this data dir", data_dir)
+            else:
+                self._gc_mode = True
         self.wals: List[WAL] = []
         self.plogs: List[PayloadLog] = []
         self._commit_qs: List["queue.Queue"] = [queue.Queue()
@@ -270,6 +299,29 @@ class ClusterHostPlane:
                           if os.environ.get("RAFTSQL_FUSED_NATIVE_PLOG")
                           == "1" else None)
 
+        # Double-buffered dispatch (RAFTSQL_OVERLAP_DISPATCH, default
+        # on): tick t's heavy durable phase (WAL writes + the fsync
+        # barrier) is STASHED at the end of tick t and retired inside
+        # tick t+1's device-dispatch window — the disk and the device
+        # work at the same time instead of in series.  Correctness gate
+        # (the module-doc contract, re-proved for the pipeline):
+        # durable phase t+1 begins only after durable phase t fully
+        # completed, and publish/acks for tick t follow its own
+        # barrier — so when any effect of a message is durable or
+        # externalized, its cause is durable.  The speculative dispatch
+        # t+1 (which observes tick t's not-yet-fsynced messages) lives
+        # only in volatile device memory until then; a crash loses the
+        # stash and dispatch together, and replay resumes from the last
+        # completed barrier (multi-step dispatches keep their epoch
+        # framing — an uncommitted epoch is erased on every peer).
+        # Proposal POPS for the stashed tick happen at stage time, so
+        # the next _build_prop_n snapshot (and its re-routes) see
+        # exactly the queue state the serialized pipeline would — the
+        # chaos digest must not move under overlap.
+        self._overlap = os.environ.get(
+            "RAFTSQL_OVERLAP_DISPATCH", "1") == "1"
+        self._stash: Optional[tuple] = None    # (step_infos, staged)
+
         # Multi-step dispatch epoch state (see tick()): the committed
         # epoch lives in data_dir/EPOCHS (12-byte records, fsynced once
         # per multi-step dispatch AFTER every peer's WAL barrier — the
@@ -323,6 +375,7 @@ class ClusterHostPlane:
                                        *per_peer)
         self.inboxes = empty_cluster_inbox(cfg)
         self._E = cfg.max_entries_per_msg
+        self._gc_replay = None          # free the boot replay cache
 
     # -- subclass seams -------------------------------------------------
 
@@ -337,16 +390,41 @@ class ClusterHostPlane:
 
     def _new_wal(self, dirname: str) -> WAL:
         """Construct a peer's durable log handle.  The mesh runtime
-        overrides this with a per-group-shard layout (ShardedWAL)."""
+        overrides this with a per-group-shard layout (ShardedWAL); the
+        group-commit mode hands out per-peer views of ONE shared log."""
+        if self._gc_mode:
+            if self._gcwal is None:
+                from raftsql_tpu.storage.wal import GroupCommitWAL
+                self._gcwal = GroupCommitWAL(
+                    self._gc_dir, self.cfg.num_peers,
+                    self.cfg.num_groups,
+                    segment_bytes=self.cfg.wal_segment_bytes)
+            return self._gcwal.view(self.dirs.index(dirname))
         return WAL(dirname, segment_bytes=self.cfg.wal_segment_bytes)
 
     def _wal_exists(self, dirname: str) -> bool:
+        if self._gc_mode:
+            from raftsql_tpu.storage.wal import GroupCommitWAL
+            return GroupCommitWAL.exists(self._gc_dir)
         return wal_exists(dirname)
 
     def _wal_replay(self, dirname: str):
+        if self._gc_mode:
+            from raftsql_tpu.storage.wal import GroupCommitWAL
+            if self._gc_replay is None:
+                self._gc_replay = GroupCommitWAL.replay_flat(self._gc_dir)
+            return GroupCommitWAL.split_replay(
+                self._gc_replay, self.dirs.index(dirname),
+                self.cfg.num_groups)
         return WAL.replay(dirname)
 
     def _wal_repair_epochs(self, dirname: str, committed: int) -> None:
+        if self._gc_mode:
+            if not self._gc_repaired:
+                self._gc_repaired = True
+                from raftsql_tpu.storage.wal import GroupCommitWAL
+                GroupCommitWAL.repair_epochs(self._gc_dir, committed)
+            return
         WAL.repair_epochs(dirname, committed)
 
     def _pub_shard_groups(self) -> List[Optional[np.ndarray]]:
@@ -433,6 +511,12 @@ class ClusterHostPlane:
         from raftsql_tpu.membership import MembershipManager
         if self.membership is not None:
             return
+        # Leave the static-full-voter fast path (config.py
+        # dynamic_membership): the device program must start reading the
+        # per-group masks BEFORE any of them can change.  One recompile.
+        import dataclasses as _dc
+        if self.cfg.static_full_voters:
+            self.cfg = _dc.replace(self.cfg, dynamic_membership=True)
         P, G = self.cfg.num_peers, self.cfg.num_groups
         iv = initial_voters if initial_voters is not None \
             else self.cfg.initial_voters
@@ -722,7 +806,11 @@ class ClusterHostPlane:
         """Block until every enqueued publish has been delivered (the
         bench and tests read apply-plane state right after a tick
         loop).  Re-raises a publish fault — the async path must fail as
-        loudly as the inline one did."""
+        loudly as the inline one did.  Manual-tick callers (no tick
+        thread) also retire any stashed double-buffered durable phase
+        first — this is the pipeline drain."""
+        if self._thread is None:
+            self._drain_pipeline()
         for q in self._pub_qs:
             q.join()
         if self.error is not None:
@@ -829,6 +917,16 @@ class ClusterHostPlane:
                              self.states.votes, self.inboxes.v_type,
                              self.inboxes.a_type, self._applied)
         t1 = _t.monotonic()
+        # Double-buffered dispatch: the PREVIOUS tick's stashed durable
+        # phase (WAL writes + fsync barrier + publish) runs HERE, inside
+        # this dispatch's device window — tick t's disk time overlaps
+        # tick t+1's compute.  Strictly ordered: this completes before
+        # this tick's own durable phase can begin.
+        if self._stash is not None:
+            tw0 = _t.monotonic()
+            self._retire_stash()
+            self.metrics.overlap_ticks += 1
+            self.metrics.t_wal_ms += (_t.monotonic() - tw0) * 1e3
         # Overlap: tick t-1's commits are durable (fsynced last tick).
         # Parallel hosts hand them to the publish workers (the apply
         # plane runs concurrently with this whole tick); a 1-core host
@@ -869,30 +967,27 @@ class ClusterHostPlane:
                       if pinfo.ndim == 4 else [pinfo])
         pinfo = step_infos[-1]
         self._hints = pinfo[0, :, _C["leader_hint"]]
-        # Multi-step dispatches are epoch-framed (see _ensure_epoch_
-        # begin / _commit_epoch): BEGIN lazily wraps each peer's first
-        # write, END lands before its fsync, and the dispatch commits
-        # atomically below.
-        self._ep_active = len(step_infos) > 1
-        if self._ep_active:
-            self._ep_begun = [False] * self.cfg.num_peers
-            self._ep_no_this = None
-        tick_active = False
-        for si, pi in enumerate(step_infos):
-            tick_active = self._durable_phases(
-                pi, final=(si == len(step_infos) - 1)) or tick_active
-        if self._ep_active and self._ep_no_this is not None:
-            # Every peer's barrier is down; this fsync is the
-            # dispatch's atomic commit point (before any publish).
-            self._epoch_no = self._ep_no_this
-            self._commit_epoch(self._epoch_no)
-        self._ep_active = False
-        if self.membership is not None:
-            # Apply-at-commit for conf entries: patch each peer row
-            # whose commit passed a pending entry, BEFORE this tick's
-            # publish enqueue (the scrub set must cover the batch).
-            self._membership_advance(pinfo)
-        t4 = _t.monotonic()
+        # Stage the 2a ranges NOW (this pops the device-accepted
+        # proposals off the queues): whether the durable phase runs
+        # inline below or stashed into the next dispatch window, the
+        # next _build_prop_n snapshot must see post-pop queue state —
+        # that is what keeps the overlapped pipeline's trajectory
+        # bit-identical to the serialized one.
+        staged = [self._stage_ranges(pi) for pi in step_infos]
+        # Content-derived activity signals (durable-independent so the
+        # stash decision cannot change them): any append staged or
+        # mirrored, or any hard state due to change.
+        tick_active = any(
+            bool(st_p[0]) for st in staged for st_p in st)
+        if not tick_active:
+            for pi in step_infos:
+                if (pi[:, :, _C["app_from"]] >= 0).any():
+                    tick_active = True
+                    break
+        if not tick_active:
+            hs = pinfo[:, :, [_C["term"], _C["voted_for"],
+                              _C["commit"]]]
+            tick_active = bool((hs != self._hard).any())
         # Quiescence signal for the threaded loop: anything written,
         # any group leaderless, or any proposal backlog means "keep
         # ticking at full pace".
@@ -907,6 +1002,22 @@ class ClusterHostPlane:
         # hot — warmup paces at interval_s instead of starving the
         # host core the cluster shares with its clients.
         self._spin_hot = tick_active or dev_busy or bool(self._queued)
+        # Double-buffer decision: while the pipeline is HOT another
+        # dispatch follows immediately, so this tick's durable phase is
+        # stashed and retired inside that dispatch's device window.
+        # Cold/parking ticks finish inline — deferring would add a
+        # whole (possibly parked) tick of ack latency for no overlap.
+        if self._overlap and self._spin_hot:
+            self._stash = (step_infos, staged)
+            self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
+            self._tick_active = base_active
+            self._tick_no += 1
+            self.metrics.ticks += 1
+            return
+        tick_active = self._finish_durable(step_infos, staged) \
+            or tick_active
+        base_active = base_active or tick_active
+        t4 = _t.monotonic()
         if base_active:
             if self._host_parallel:
                 # The publish workers ARE the overlap: hand the tick's
@@ -944,63 +1055,80 @@ class ClusterHostPlane:
             self._pending_pinfo = None
         self._tick_active = base_active
         self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
-        self.metrics.t_wal_ms += (t4 - t3) * 1e3
+        self.metrics.t_wal_ms += (_t.monotonic() - t4) * 1e3
         self._tick_no += 1
         self.metrics.ticks += 1
 
-    def _durable_phases(self, pinfo: np.ndarray, final: bool) -> bool:
-        """The durable host phases for ONE step's packed info [P,G,C]:
-        phase 1 collects mirror METADATA (peer, src, group, start,
-        count, new_len) with no reads; phase 2a writes leader appends
-        (fresh-leader no-ops + accepted proposals) as uniform-term
-        RANGES; phase 2b mirrors follower appends.  Mirror-source
-        staging happens inside 2b AFTER 2a's appends — safe because 2a
-        writes are pure TAIL appends strictly above any mirrored range
-        (mirror ranges were composed from the source's ring at the end
-        of the PREVIOUS step), and the only same-step writes that can
-        truncate or overwrite a mirrored range are OTHER MIRRORS, which
-        both 2b paths stage fully before writing.  Any future 2a change
-        that is not a pure tail append breaks this argument and must
-        move 2a after 2b's staging.
+    def _retire_stash(self) -> None:
+        """Run the stashed tick's durable phase + publish (the
+        double-buffered pipeline's back half).  Caller order guarantees
+        this precedes the NEXT durable phase and its publish."""
+        import time as _t
+        step_infos, staged = self._stash
+        self._stash = None
+        self._finish_durable(step_infos, staged)
+        pinfo = step_infos[-1]
+        if self._host_parallel:
+            self._enqueue_publish(pinfo)
+        else:
+            tp = _t.monotonic()
+            self._publish(pinfo)
+            self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
 
-        On the dispatch's FINAL step only, phase 2c (hard states) and
-        the per-peer fsync barrier run — a multi-step dispatch saves
-        every step's entries, then one hard state, then one fsync,
-        which is the etcd wal.Save order at dispatch granularity.
-        Returns tick_active (entries or hard states written)."""
+    def _drain_pipeline(self) -> None:
+        """Retire any stashed durable phase (manual-tick callers: the
+        bench, chaos runners, tests).  NOT safe against a concurrently
+        running tick thread — stop() joins the thread first."""
+        if self._stash is not None:
+            self._retire_stash()
+
+    def _finish_durable(self, step_infos, staged) -> bool:
+        """The whole durable back half for one dispatch: per-step
+        durable phases (epoch-framed when multi-step), the epoch
+        commit, and membership apply-at-commit.  Returns tick_active
+        (anything written)."""
+        pinfo = step_infos[-1]
+        # Multi-step dispatches are epoch-framed (see _ensure_epoch_
+        # begin / _commit_epoch): BEGIN lazily wraps each peer's first
+        # write, END lands before its fsync, and the dispatch commits
+        # atomically below.
+        self._ep_active = len(step_infos) > 1
+        if self._ep_active:
+            self._ep_begun = [False] * self.cfg.num_peers
+            self._ep_no_this = None
+        tick_active = False
+        for si, (pi, st) in enumerate(zip(step_infos, staged)):
+            tick_active = self._durable_phases(
+                pi, final=(si == len(step_infos) - 1),
+                staged=st) or tick_active
+        if self._ep_active and self._ep_no_this is not None:
+            # Every peer's barrier is down; this fsync is the
+            # dispatch's atomic commit point (before any publish).
+            self._epoch_no = self._ep_no_this
+            self._commit_epoch(self._epoch_no)
+        self._ep_active = False
+        if self.membership is not None:
+            # Apply-at-commit for conf entries: patch each peer row
+            # whose commit passed a pending entry, BEFORE this tick's
+            # publish enqueue (the scrub set must cover the batch).
+            self._membership_advance(pinfo)
+        if self._gcwal is not None:
+            self.metrics.wal_group_commits = self._gcwal.group_commits
+        return tick_active
+
+    def _stage_ranges(self, pinfo: np.ndarray) -> list:
+        """Build one step's phase-2a write plan — per peer the
+        (r_g, r_start, r_count, r_term, w_d) uniform-term ranges of
+        fresh-leader no-ops + accepted proposals — POPPING the accepted
+        payloads off the proposal queues.  Runs at stage time, in the
+        tick that read this pinfo: the pops must settle before the next
+        tick's _build_prop_n snapshot (offer counts and re-routes read
+        queue lengths), whether the heavy durable write runs inline or
+        stashed into the next dispatch window.  Side effects that ride
+        the pop (conf-entry notes, tracer append stamps, the proposals
+        counter) happen here too, in step order."""
         P = self.cfg.num_peers
-        m_peer: List[int] = []
-        m_src: List[int] = []
-        m_g: List[int] = []
-        m_start: List[int] = []
-        m_count: List[int] = []
-        m_newlen: List[int] = []
-        for p in range(P):
-            col = pinfo[p]
-            accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
-            if not accepted.size:
-                continue
-            sub = col[accepted]
-            m_peer.extend([p] * accepted.size)
-            m_g.extend(accepted.tolist())
-            m_src.extend(sub[:, _C["app_from"]].tolist())
-            m_start.extend(sub[:, _C["app_start"]].tolist())
-            m_count.extend(sub[:, _C["app_n"]].tolist())
-            m_newlen.extend(sub[:, _C["new_log_len"]].tolist())
-
-        if self.tracer is not None and m_peer:
-            # Replicate stamp: the mirrored range is landing in a
-            # follower's log this step (first stamp wins per index).
-            for g, st, c in zip(m_g, m_start, m_count):
-                if c:
-                    self.tracer.note_replicate(g, st + c - 1)
-
-        # Phase 2a: leader appends (fresh-leader no-ops + accepted
-        # proposals) as uniform-term RANGES per peer: one combined
-        # native call writes the WAL records and the payload-log range
-        # (wal.append_ranges_uniform); the fallback expands ranges to
-        # per-entry numpy columns for the classic two-call path.
-        tick_active = bool(m_peer)
+        out = []
         for p in range(P):
             col = pinfo[p]
             noop = col[:, _C["noop"]]
@@ -1060,6 +1188,68 @@ class ClusterHostPlane:
                         self.tracer.note_append(
                             g, b0, [d.decode("utf-8", "replace")
                                     for d in batch])
+            out.append((r_g, r_start, r_count, r_term, w_d))
+        return out
+
+    def _durable_phases(self, pinfo: np.ndarray, final: bool,
+                        staged: list) -> bool:
+        """The durable host phases for ONE step's packed info [P,G,C]:
+        phase 1 collects mirror METADATA (peer, src, group, start,
+        count, new_len) with no reads; phase 2a writes leader appends
+        (fresh-leader no-ops + accepted proposals, pre-popped into
+        `staged` by _stage_ranges) as uniform-term RANGES; phase 2b
+        mirrors follower appends.  Mirror-source
+        staging happens inside 2b AFTER 2a's appends — safe because 2a
+        writes are pure TAIL appends strictly above any mirrored range
+        (mirror ranges were composed from the source's ring at the end
+        of the PREVIOUS step), and the only same-step writes that can
+        truncate or overwrite a mirrored range are OTHER MIRRORS, which
+        both 2b paths stage fully before writing.  Any future 2a change
+        that is not a pure tail append breaks this argument and must
+        move 2a after 2b's staging.
+
+        On the dispatch's FINAL step only, phase 2c (hard states) and
+        the per-peer fsync barrier run — a multi-step dispatch saves
+        every step's entries, then one hard state, then one fsync,
+        which is the etcd wal.Save order at dispatch granularity.
+        Returns tick_active (entries or hard states written)."""
+        P = self.cfg.num_peers
+        m_peer: List[int] = []
+        m_src: List[int] = []
+        m_g: List[int] = []
+        m_start: List[int] = []
+        m_count: List[int] = []
+        m_newlen: List[int] = []
+        for p in range(P):
+            col = pinfo[p]
+            accepted = np.nonzero(col[:, _C["app_from"]] >= 0)[0]
+            if not accepted.size:
+                continue
+            sub = col[accepted]
+            m_peer.extend([p] * accepted.size)
+            m_g.extend(accepted.tolist())
+            m_src.extend(sub[:, _C["app_from"]].tolist())
+            m_start.extend(sub[:, _C["app_start"]].tolist())
+            m_count.extend(sub[:, _C["app_n"]].tolist())
+            m_newlen.extend(sub[:, _C["new_log_len"]].tolist())
+
+        if self.tracer is not None and m_peer:
+            # Replicate stamp: the mirrored range is landing in a
+            # follower's log this step (first stamp wins per index).
+            for g, st, c in zip(m_g, m_start, m_count):
+                if c:
+                    self.tracer.note_replicate(g, st + c - 1)
+
+        # Phase 2a: leader appends (fresh-leader no-ops + accepted
+        # proposals) as uniform-term RANGES per peer — the write plan
+        # was staged (and the payloads popped) by _stage_ranges; one
+        # combined native call writes the WAL records and the
+        # payload-log range (wal.append_ranges_uniform); the fallback
+        # expands ranges to per-entry numpy columns for the classic
+        # two-call path.
+        tick_active = bool(m_peer)
+        for p in range(P):
+            r_g, r_start, r_count, r_term, w_d = staged[p]
             if not r_g:
                 continue
             tick_active = True
@@ -1365,6 +1555,16 @@ class ClusterHostPlane:
             self._work_evt.set()
             self._thread.join(timeout=10)
             self._thread = None
+        if self.error is None:
+            # Clean shutdown retires the double-buffered tail (WAL
+            # write + fsync + publish) so nothing acked-able is lost;
+            # an errored engine must NOT touch the WALs again.
+            try:
+                self._drain_pipeline()
+            except Exception as e:      # pragma: no cover - defensive
+                self.error = e
+        else:
+            self._stash = None
         if self._pending_pinfo is not None:
             self._enqueue_publish(self._pending_pinfo)  # already durable
             self._pending_pinfo = None

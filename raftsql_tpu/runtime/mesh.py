@@ -286,6 +286,10 @@ class MeshClusterNode(ClusterHostPlane):
     group shard.
     """
 
+    # The per-shard WAL layout supersedes the single-file group-commit
+    # layout (each shard dir is its own append+fsync stream).
+    supports_group_commit = False
+
     def __init__(self, cfg: RaftConfig, data_dir: str, mesh,
                  seed: Optional[int] = None):
         gg = mesh.shape[GROUPS_AXIS]
@@ -330,6 +334,15 @@ class MeshClusterNode(ClusterHostPlane):
         else:
             with open(path, "w", encoding="utf-8") as f:
                 json.dump({"group_shards": gg}, f)
+
+    def enable_membership(self, initial_voters=None) -> None:
+        # The sharded step closure captured the construction-time cfg;
+        # rebuild it after the host plane leaves the static-full-voter
+        # fast path (config.py dynamic_membership) so the mesh program
+        # reads the masks membership will patch.
+        super().enable_membership(initial_voters)
+        self._sharded_step = make_sharded_cluster_step_host(self.cfg,
+                                                            self.mesh)
 
     # -- host-plane seams (runtime/hostplane.py) ------------------------
 

@@ -452,6 +452,13 @@ class RaftNode:
     def _patch_group_config(self, g: int, durable: bool = True) -> None:
         """Push group g's applied config into the device masks and
         (durable=True) the WAL baseline.  Tick thread (or __init__)."""
+        # First conf this node ever sees: leave the static-full-voter
+        # fast path so the step reads the masks this patch writes
+        # (config.py dynamic_membership; one recompile, conf changes
+        # are rare admin events).
+        if self.cfg.static_full_voters:
+            import dataclasses as _dc
+            self.cfg = _dc.replace(self.cfg, dynamic_membership=True)
         mm = self.membership
         vrow, jrow, selfv = mm.device_rows(g, self.self_id)
         self.state = set_group_config(self.state, g, vrow, jrow, selfv)

@@ -99,7 +99,8 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                      resume: bool = False,
                      compact_every: int = 0, compact_keep: int = 1024,
                      wal_segment_bytes: int = 4 << 20,
-                     trace: bool = False) -> RaftDB:
+                     trace: bool = False,
+                     wal_group_commit: bool = True) -> RaftDB:
     """The --fused single-process deployment: all P peers of every
     group co-located in THIS process, consensus advanced by ONE fused
     device program per tick (runtime/fused.py), per-peer WALs on disk,
@@ -112,7 +113,12 @@ def build_fused_node(groups: int = 1, peers: int = 3,
     cfg = RaftConfig(num_groups=groups, num_peers=peers,
                      tick_interval_s=tick,
                      wal_segment_bytes=wal_segment_bytes)
-    node = FusedClusterNode(cfg, f"{data_prefix}-fused")
+    # WAL group commit is the serving default: one write+fsync per tick
+    # for all P peers (storage/wal.py GroupCommitWAL).  An existing
+    # per-peer data dir keeps its layout (the host plane refuses to
+    # mix them); --wal-group-commit=off restores per-peer files.
+    node = FusedClusterNode(cfg, f"{data_prefix}-fused",
+                            group_commit=wal_group_commit)
     if trace:
         node.enable_tracing()
     node.start(interval_s=max(tick, 0.0005))
@@ -284,6 +290,17 @@ def main(argv=None) -> None:
     ap.add_argument("--peer-shards", type=int, default=1,
                     help="with --mesh: devices on the peers axis (the "
                          "message exchange then rides all_to_all)")
+    ap.add_argument("--wal-group-commit", choices=("on", "off"),
+                    default="on",
+                    help="with --fused: coalesce every peer's per-tick "
+                         "WAL records into ONE shared log + ONE fsync "
+                         "(storage/wal.py GroupCommitWAL)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="N HTTP worker PROCESSES sharing this engine "
+                         "through mmap propose/completion rings "
+                         "(runtime/ring.py), all binding --port via "
+                         "SO_REUSEPORT.  0 = serve HTTP in-process "
+                         "(the classic single-process deployment)")
     ap.add_argument("--http-engine", choices=("aio", "threaded"),
                     default="aio",
                     help="HTTP plane: single-thread event loop with "
@@ -335,7 +352,9 @@ def main(argv=None) -> None:
                                compact_every=args.compact_every,
                                compact_keep=args.compact_keep,
                                wal_segment_bytes=args.wal_segment_bytes,
-                               trace=args.trace)
+                               trace=args.trace,
+                               wal_group_commit=args.wal_group_commit
+                               == "on")
     else:
         rdb = build_node(args.cluster, args.id, groups=args.groups,
                          tick=args.tick, resume=args.resume,
@@ -344,6 +363,9 @@ def main(argv=None) -> None:
                          wal_segment_bytes=args.wal_segment_bytes,
                          trace=args.trace)
     _watch_fatal(rdb)
+    if args.workers > 0:
+        _serve_workers(rdb, args)
+        return
     if args.http_engine == "aio":
         from raftsql_tpu.api.aio import AioSQLServer
         srv = AioSQLServer(args.port, rdb)
@@ -351,6 +373,73 @@ def main(argv=None) -> None:
         srv = SQLServer(args.port, rdb)
     _install_graceful_shutdown(rdb, srv.stop)
     srv.serve_forever()
+
+
+def _serve_workers(rdb, args) -> None:
+    """The --workers N deployment: this process runs ONLY the engine
+    (consensus tick + WAL + SQLite apply) and the ring drain
+    (runtime/ring.py RingServer); N child processes each run the
+    asyncio HTTP plane over a RingClient, all bound to --port via
+    SO_REUSEPORT.  HTTP parsing/ack serialization then spends other
+    GILs, not the engine's.
+
+    A worker that dies is respawned (it holds no state); the engine
+    dying is fatal for everyone (EXIT_CODE_FATAL via _watch_fatal)."""
+    import subprocess
+
+    from raftsql_tpu.runtime.ring import RingServer
+
+    log = logging.getLogger("raftsql.server")
+    ring_dir = f"raftsql-rings-{os.getpid()}"
+    ring = RingServer(rdb, ring_dir, args.workers)
+    ring.start()
+
+    def _die_with_parent():
+        # PR_SET_PDEATHSIG: a worker must not outlive its engine — a
+        # SIGKILLed engine (crash, OOM) would otherwise leave orphan
+        # workers serving a dead ring forever.
+        try:
+            import ctypes
+            ctypes.CDLL("libc.so.6", use_errno=True).prctl(
+                1, signal.SIGTERM)
+        except OSError:                  # pragma: no cover - non-linux
+            pass
+
+    def spawn(i: int) -> "subprocess.Popen":
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return subprocess.Popen(
+            [sys.executable, "-m", "raftsql_tpu.server.worker",
+             "--rings", ring_dir, "--index", str(i),
+             "--port", str(args.port)]
+            + (["--verbose"] if args.verbose else []),
+            env=env, preexec_fn=_die_with_parent)
+
+    procs = [spawn(i) for i in range(args.workers)]
+    stopping = threading.Event()
+
+    def _stop_all():
+        stopping.set()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:                           # noqa: BLE001
+                p.kill()
+        ring.stop()
+
+    _install_graceful_shutdown(rdb, _stop_all)
+    log.info("engine up; %d HTTP workers on port %d (rings in %s)",
+             args.workers, args.port, ring_dir)
+    while True:
+        for i, p in enumerate(procs):
+            rc = p.poll()
+            if rc is not None and not stopping.is_set():
+                log.warning("worker %d exited rc=%s; respawning", i, rc)
+                procs[i] = spawn(i)
+        time.sleep(0.5)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,61 @@
+"""HTTP worker process for the multi-worker serving plane.
+
+Spawned by `server/main.py --workers N` (one process per worker): runs
+the asyncio HTTP plane (api/aio.py) over a `RingClient` facade
+(runtime/ring.py) instead of an in-process RaftDB — every proposal
+becomes a record in this worker's mmap'd propose ring, every ack a
+completion-ring record resolved into the event loop.  All N workers
+bind the SAME port with SO_REUSEPORT; the kernel spreads connections.
+
+The worker holds no consensus, storage, or SQLite state: it can be
+killed and respawned freely (in-flight requests on its connections
+fail; the engine's retry-token dedup keeps client-side retries
+exactly-once).  It exits when its parent's rings disappear or on
+SIGTERM.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="raftsql HTTP ring worker")
+    ap.add_argument("--rings", required=True,
+                    help="ring directory created by the engine process")
+    ap.add_argument("--index", type=int, required=True,
+                    help="worker index (selects the ring pair)")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s worker%(process)d %(levelname)s %(message)s")
+
+    # The worker never touches a device — pin the cpu backend before
+    # anything imports jax so a wedged accelerator tunnel cannot hang
+    # HTTP serving (same hazard as server/main.py _pin_platform).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from raftsql_tpu.api.aio import AioSQLServer
+    from raftsql_tpu.runtime.ring import RingClient
+
+    rdb = RingClient(args.rings, args.index)
+    srv = AioSQLServer(args.port, rdb, timeout_s=args.timeout,
+                       reuse_port=True)
+
+    def _term(signum, frame):
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        srv.serve_forever()
+    finally:
+        rdb.close()
+
+
+if __name__ == "__main__":
+    main()

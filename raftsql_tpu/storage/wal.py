@@ -192,7 +192,11 @@ def wal_mirror_all(wals, plogs, peers, srcs, groups, starts, counts,
     read-all-before-write-all contract that makes same-tick source
     truncation safe), phase B writes each destination peer's WAL ENTRY
     records + payload-log range + truncation.  Returns False when the
-    native path is unavailable on any peer (caller falls back)."""
+    native path is unavailable on any peer (caller falls back).
+
+    Destination WALs may be group-commit views (GroupCommitWAL below):
+    their `group_bias` flattens the record's group id into the shared
+    multiplexed stream, applied on the WAL side only."""
     if not wals:
         return True
     lib = wals[0]._lib
@@ -210,6 +214,8 @@ def wal_mirror_all(wals, plogs, peers, srcs, groups, starts, counts,
     P = len(wals)
     wh = (ctypes.c_void_p * P)(*[w._h for w in wals])
     ph = (ctypes.c_void_p * P)(*[p.handle for p in plogs])
+    biases = np.asarray([getattr(w, "group_bias", 0) for w in wals],
+                        np.uint32)
     pa = np.asarray(peers, np.uint32)
     sa = np.asarray(srcs, np.uint32)
     ga = np.asarray(groups, np.uint32)
@@ -225,14 +231,16 @@ def wal_mirror_all(wals, plogs, peers, srcs, groups, starts, counts,
         ia.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         na.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        per_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        per_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        biases.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
     if rc != 0:
         raise ValueError("walplog_mirror_all: source range unavailable")
     for i in range(n):
         c = int(ca[i])
         if c:
-            wals[int(pa[i])]._active_stats.bump(
-                int(ga[i]), int(ia[i]) + c - 1)
+            w = wals[int(pa[i])]
+            w._active_stats.bump(
+                int(ga[i]) + int(biases[int(pa[i])]), int(ia[i]) + c - 1)
     for p in range(P):
         b = int(per_bytes[p])
         if b:
@@ -467,14 +475,17 @@ class WAL:
             self._write(body)
 
     def append_ranges_uniform(self, plog, groups, starts, counts, terms,
-                              blob: bytes, lens) -> bool:
+                              blob: bytes, lens,
+                              group_bias: int = 0) -> bool:
         """Combined native write (walplog_put_uniform): for each range
         (group, start, count, term) write ONE WAL RANGE record AND the
         native payload-log range, all in one C call — zero per-entry
         Python.  `blob` concatenates every range's payload bytes in
         order; `lens` is per-entry.  Returns False when the native
         combined path is unavailable (caller falls back to
-        append_entries + plog.put_ranges)."""
+        append_entries + plog.put_ranges).  `group_bias` offsets the
+        WAL records' group ids only (the group-commit multiplexed
+        layout); the payload log is indexed by the raw group."""
         if self._lib is None or plog is None \
                 or not hasattr(self._lib, "walplog_put_uniform"):
             return False
@@ -496,14 +507,15 @@ class WAL:
             ca.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             ta.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             blob,
-            la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            la.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            group_bias)
         if rc != 0:
             raise ValueError("walplog_put_uniform: payload gap")
         bump = self._active_stats.bump
         live = 0
         for g, s, c in zip(ga.tolist(), sa.tolist(), ca.tolist()):
             if c:             # native side skips empty runs entirely
-                bump(g, s + c - 1)
+                bump(g + group_bias, s + c - 1)
                 live += 1
         self._pending = True
         # One RANGE record per non-empty run (native writes type-5 —
@@ -977,3 +989,259 @@ class WAL:
                 if gl.conf is None or index >= gl.conf[0]:
                     gl.conf = (index, kind, voters, joint, learners)
         return True
+
+
+# ---------------------------------------------------------------------------
+# WAL group commit (PR 7): one physical log — one append stream, one
+# fsync — for ALL P peers of a co-located cluster.
+
+
+class GroupCommitWAL:
+    """Multiplex P peers' logical WALs into ONE physical segmented log.
+
+    The fused runtime's durable barrier was P fsyncs in flight (one per
+    peer directory) per tick; on one data directory those target the
+    same device, so the barrier pays P journal commits for one tick's
+    worth of records.  This layout coalesces them: every peer's records
+    land in one shared `WAL` (same record formats, same segmentation,
+    same repair/compaction machinery) keyed by the FLAT group id
+    `peer * G + g`, and the tick's barrier is ONE write+fsync covering
+    every peer — a group commit whose batch is whatever the tick wrote.
+    Durability semantics are unchanged: sync() returning still means
+    every peer's records of the tick are on disk (they are in the same
+    file, so trivially so), and the batch window is the tick itself —
+    it adapts to load because a saturated tick simply carries more
+    records into the same single commit.
+
+    `view(peer)` returns the per-peer facade the host plane writes
+    through (the WAL write surface with the peer's `group_bias` applied
+    on the way in); `replay/exists/repair_epochs` are the matching
+    whole-directory forms, with `split_replay` giving the per-peer
+    slice the host plane's restore path consumes.
+
+    Observability: `group_commits` counts actual fsyncs, `batch_hist`
+    maps peers-per-commit → count (the bench's group-commit histogram),
+    and the owning runtime exports both via /metrics
+    (`wal_group_commits`).
+    """
+
+    def __init__(self, dirname: str, num_peers: int, num_groups: int,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
+        import threading
+        self.num_peers = num_peers
+        self.num_groups = num_groups
+        self.base = WAL(dirname, segment_bytes=segment_bytes)
+        self._mu = threading.Lock()
+        self._dirty: Set[int] = set()
+        self._open_views = 0
+        self._epoch_last: Optional[Tuple[int, bool]] = None
+        self._floors: Dict[int, Tuple[int, int]] = {}
+        self._hard: Dict[int, Tuple[int, int, int]] = {}
+        self.group_commits = 0
+        self.batch_hist: Dict[int, int] = {}
+        self._views = [WALGroupView(self, p) for p in range(num_peers)]
+
+    # -- per-peer facades ------------------------------------------------
+
+    def view(self, peer: int) -> "WALGroupView":
+        self._open_views += 1
+        return self._views[peer]
+
+    # -- whole-directory forms -------------------------------------------
+
+    @staticmethod
+    def exists(dirname: str) -> bool:
+        return wal_exists(dirname)
+
+    @staticmethod
+    def replay_flat(dirname: str) -> Dict[int, GroupLog]:
+        return WAL.replay(dirname)
+
+    @staticmethod
+    def split_replay(flat: Dict[int, GroupLog], peer: int,
+                     num_groups: int) -> Dict[int, GroupLog]:
+        lo, hi = peer * num_groups, (peer + 1) * num_groups
+        return {fg - lo: gl for fg, gl in flat.items() if lo <= fg < hi}
+
+    @staticmethod
+    def repair_epochs(dirname: str, committed: int) -> bool:
+        return WAL.repair_epochs(dirname, committed)
+
+    # -- shared write machinery (called by the views) --------------------
+
+    def note_write(self, peer: int) -> None:
+        self._dirty.add(peer)
+
+    def epoch_mark(self, no: int, end: bool) -> None:
+        """One BEGIN/END frame per dispatch for the WHOLE shared stream
+        (the host plane asks per peer; duplicates carry no information
+        here because all peers' records share the file).  The dedupe
+        check holds the lock across the write so a racing parallel
+        worker can never slip a record ahead of the BEGIN it relies
+        on."""
+        with self._mu:
+            key = (no, end)
+            if self._epoch_last == key:
+                return
+            self._epoch_last = key
+            self.base.epoch_mark(no, end)
+
+    def sync(self) -> None:
+        """The group commit: first caller flushes + fsyncs EVERYTHING
+        every peer wrote since the last barrier; the other peers'
+        sync() calls find nothing pending and return — P calls, one
+        fsync."""
+        with self._mu:
+            if not self.base._pending:
+                return
+            batch = len(self._dirty) or 1
+            self._dirty.clear()
+            self.base.sync()
+            self.group_commits += 1
+            self.batch_hist[batch] = self.batch_hist.get(batch, 0) + 1
+
+    def compact_view(self, bias: int, floors, hard) -> int:
+        """Per-view compaction: floors/hard merge into the cluster-wide
+        flat dicts (segment deletability needs EVERY peer's floors —
+        one peer's view alone could never prove a shared segment
+        fully superseded)."""
+        with self._mu:
+            self._floors.update(
+                {g + bias: v for g, v in floors.items()})
+            self._hard.update({g + bias: v for g, v in hard.items()})
+            return self.base.compact(dict(self._floors),
+                                     dict(self._hard))
+
+    def close_view(self) -> None:
+        with self._mu:
+            self._open_views -= 1
+            if self._open_views <= 0:
+                self.base.close()
+
+
+class WALGroupView:
+    """One peer's write surface over a GroupCommitWAL: the WAL API the
+    host plane uses, with `group_bias` flattening this peer's group ids
+    into the shared stream.  NOT constructed directly — GroupCommitWAL
+    hands them out."""
+
+    def __init__(self, owner: GroupCommitWAL, peer: int):
+        self._owner = owner
+        self.peer = peer
+        self.group_bias = peer * owner.num_groups
+
+    # Shared-state delegation: the native mirror path (wal_mirror_all)
+    # talks to `_lib`/`_h` and writes `_pending`/`_bytes`/stat bumps —
+    # all live on the one shared base WAL.
+    @property
+    def _lib(self):
+        return self._owner.base._lib
+
+    @property
+    def _h(self):
+        return self._owner.base._h
+
+    @property
+    def _f(self):
+        return self._owner.base._f
+
+    @property
+    def _active_stats(self):
+        return self._owner.base._active_stats
+
+    @property
+    def _pending(self):
+        return self._owner.base._pending
+
+    @_pending.setter
+    def _pending(self, v) -> None:
+        self._owner.base._pending = v
+        if v:
+            self._owner.note_write(self.peer)
+
+    @property
+    def _bytes(self):
+        return self._owner.base._bytes
+
+    @_bytes.setter
+    def _bytes(self, v) -> None:
+        self._owner.base._bytes = v
+
+    @property
+    def obs(self):
+        return self._owner.base.obs
+
+    @obs.setter
+    def obs(self, tracer) -> None:
+        self._owner.base.obs = tracer
+
+    @property
+    def last_sync_s(self) -> float:
+        return self._owner.base.last_sync_s
+
+    # -- biased write surface --------------------------------------------
+
+    def _touch(self) -> None:
+        self._owner.note_write(self.peer)
+
+    def append_entry(self, group, index, term, data) -> None:
+        self._touch()
+        self._owner.base.append_entry(group + self.group_bias, index,
+                                      term, data)
+
+    def append_entries(self, groups, indexes, terms, datas) -> None:
+        self._touch()
+        self._owner.base.append_entries(
+            [g + self.group_bias for g in groups], indexes, terms, datas)
+
+    def append_ranges(self, groups, starts, counts, terms,
+                      datas) -> None:
+        self._touch()
+        self._owner.base.append_ranges(
+            [int(g) + self.group_bias for g in groups], starts, counts,
+            terms, datas)
+
+    def append_ranges_uniform(self, plog, groups, starts, counts, terms,
+                              blob, lens) -> bool:
+        self._touch()
+        return self._owner.base.append_ranges_uniform(
+            plog, groups, starts, counts, terms, blob, lens,
+            group_bias=self.group_bias)
+
+    def set_hardstate(self, group, term, vote, commit) -> None:
+        self._touch()
+        self._owner.base.set_hardstate(group + self.group_bias, term,
+                                       vote, commit)
+
+    def set_hardstates(self, groups, terms, votes, commits) -> None:
+        import numpy as np
+        self._touch()
+        ga = np.asarray(groups, np.int64) + self.group_bias
+        self._owner.base.set_hardstates(ga, terms, votes, commits)
+
+    def set_snapshot(self, group, index, term) -> None:
+        self._touch()
+        self._owner.base.set_snapshot(group + self.group_bias, index,
+                                      term)
+
+    def set_conf(self, group, index, kind, voters, joint,
+                 learners) -> bool:
+        self._touch()
+        return self._owner.base.set_conf(group + self.group_bias, index,
+                                         kind, voters, joint, learners)
+
+    def mark_compact(self, group, index, term) -> None:
+        self._owner.base.mark_compact(group + self.group_bias, index,
+                                      term)
+
+    def epoch_mark(self, no: int, end: bool) -> None:
+        self._owner.epoch_mark(no, end)
+
+    def sync(self) -> None:
+        self._owner.sync()
+
+    def compact(self, floors, hard) -> int:
+        return self._owner.compact_view(self.group_bias, floors, hard)
+
+    def close(self) -> None:
+        self._owner.close_view()

@@ -29,6 +29,13 @@ class NodeMetrics:
     # members_learners are computed live from the manager at export
     # time (runtime/db.py metrics()).
     conf_changes_applied: int = 0
+    # Serving-plane 10x counters (PR 7): WAL group commits — one
+    # write+fsync covering EVERY peer's tick records (storage/wal.py
+    # GroupCommitWAL) — and double-buffered dispatch ticks, where the
+    # previous tick's durable phase ran inside the next dispatch's
+    # device window (runtime/hostplane.py overlap pipeline).
+    wal_group_commits: int = 0
+    overlap_ticks: int = 0
     # Fault counters (chaos/ harness + storage fsio shim): injected
     # message-plane faults and storage faults survived by this node.
     # Zero outside chaos runs; exported so a chaos'd deployment's
@@ -73,6 +80,8 @@ class NodeMetrics:
             "snapshots_sent": self.snapshots_sent,
             "snapshots_installed": self.snapshots_installed,
             "conf_changes_applied": self.conf_changes_applied,
+            "wal_group_commits": self.wal_group_commits,
+            "overlap_ticks": self.overlap_ticks,
             "faults": {
                 "dropped_msgs": self.faults_dropped_msgs,
                 "delayed_msgs": self.faults_delayed_msgs,

@@ -1,0 +1,154 @@
+"""Serving smoke — the CI gate for the multi-worker deployment.
+
+Boots `server/main.py --fused --workers 2` (one engine process + two
+SO_REUSEPORT HTTP workers sharing it through the propose ring), drives
+it with the native epoll loadgen (`native/http_load.cc`; Python client
+threads when the toolchain is absent) for a few seconds, and asserts
+ZERO errors and a req/s floor.
+
+    python scripts/serving_smoke.py
+    SMOKE_SECONDS=10 SMOKE_CLIENTS=32 SMOKE_MIN_RPS=200 ...
+
+Exit 0 on pass; 1 with a diagnostic (and the server log tail) on fail.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def python_loadgen(port: int, groups: int, seconds: float,
+                   clients: int) -> dict:
+    from raftsql_tpu.api.client import RaftSQLClient
+    client = RaftSQLClient([port], timeout_s=10,
+                           max_conns_per_node=clients + 4)
+    n = [0]
+    errors = [0]
+    stop_at = time.monotonic() + seconds
+
+    def worker(ci: int) -> None:
+        k = 0
+        while time.monotonic() < stop_at:
+            k += 1
+            try:
+                client.put(f"INSERT INTO t (v) VALUES ('c{ci}_{k}')",
+                           group=(ci + k) % groups, deadline_s=10)
+                n[0] += 1
+            except Exception:                           # noqa: BLE001
+                errors[0] += 1
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.monotonic() - t0
+    client.close()
+    return {"n": n[0], "errors": errors[0], "secs": dt}
+
+
+def main() -> int:
+    groups = int(os.environ.get("SMOKE_GROUPS", "4"))
+    seconds = float(os.environ.get("SMOKE_SECONDS", "10"))
+    clients = int(os.environ.get("SMOKE_CLIENTS", "32"))
+    min_rps = float(os.environ.get("SMOKE_MIN_RPS", "200"))
+    workers = int(os.environ.get("SMOKE_WORKERS", "2"))
+    port = free_port()
+    tmp = tempfile.mkdtemp(prefix="serving-smoke-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(os.path.join(tmp, "server.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
+         "--workers", str(workers), "--groups", str(groups),
+         "--port", str(port), "--tick", "0.004"],
+        cwd=tmp, env=env, stdout=logf, stderr=logf)
+
+    def fail(msg: str) -> int:
+        print(f"serving-smoke: FAIL: {msg}", file=sys.stderr)
+        try:
+            with open(os.path.join(tmp, "server.log")) as f:
+                print(f.read()[-2000:], file=sys.stderr)
+        except OSError:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+        return 1
+
+    try:
+        from raftsql_tpu.api.client import RaftSQLClient
+        boot = RaftSQLClient([port], timeout_s=10)
+        boot.wait_healthy(0, deadline_s=120)
+        for g in range(groups):
+            boot.put("CREATE TABLE t (v text)", group=g, deadline_s=60)
+        boot.close()
+
+        loadgen = None
+        if os.environ.get("SMOKE_LOADGEN", "native") == "native":
+            from raftsql_tpu.native.build import build_http_load
+            loadgen = build_http_load()
+        if loadgen is not None:
+            out = subprocess.run(
+                [loadgen, str(seconds), str(clients), str(groups),
+                 str(port)],
+                capture_output=True, text=True, timeout=seconds + 60)
+            if out.returncode != 0:
+                return fail(f"loadgen rc={out.returncode}: "
+                            f"{out.stderr[-500:]}")
+            j = json.loads(out.stdout.strip())
+        else:
+            j = python_loadgen(port, groups, seconds, clients)
+        rate = j["n"] / max(j["secs"], 1e-9)
+        status, _, text = RaftSQLClient([port]).raw(0, "GET", "/metrics")
+        m = json.loads(text) if status == 200 else {}
+        print(f"serving-smoke: {j['n']} PUTs in {j['secs']:.1f}s -> "
+              f"{rate:,.0f} req/s, {j['errors']} errors; "
+              f"ring_workers={m.get('ring_workers')} "
+              f"wal_group_commits={m.get('wal_group_commits')} "
+              f"overlap_ticks={m.get('overlap_ticks')}")
+        if j["errors"]:
+            return fail(f"{j['errors']} errored requests")
+        if rate < min_rps:
+            return fail(f"{rate:,.0f} req/s below the {min_rps:,.0f} "
+                        "floor")
+        if m.get("ring_workers") != workers:
+            return fail(f"ring_workers={m.get('ring_workers')} != "
+                        f"{workers}")
+        proc.send_signal(signal.SIGTERM)
+        if proc.wait(timeout=30) != 0:
+            return fail(f"server exit code {proc.returncode}")
+        print("serving-smoke: PASS")
+        return 0
+    except Exception as e:                              # noqa: BLE001
+        return fail(repr(e))
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:                           # noqa: BLE001
+                proc.kill()
+        logf.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
