@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh trace clean
+	chaos-mesh trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -100,6 +100,14 @@ chaos-membership:
 chaos-procs:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --procs --seed $(SEED)
+
+# Metrics lint (scripts/check_prom.py): boot a --fused node per HTTP
+# plane (aio + threaded), drive writes, scrape GET /metrics?format=prom
+# and the Accept-negotiated path, validate the exposition under a
+# strict parser, and check every JSON /metrics field round-trips into
+# a Prometheus sample.  --url scrapes a live node instead.
+prom-lint:
+	JAX_PLATFORMS=cpu $(PY) scripts/check_prom.py
 
 # Observability demo (raftsql_tpu/obs/): run a traced fused cluster and
 # emit Chrome trace-event JSON — load trace.json at ui.perfetto.dev or

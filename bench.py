@@ -1234,6 +1234,15 @@ def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int,
                # engagement + the group-commit batch-size histogram
                # (peers coalesced per fsync -> count).
                "overlap_ticks": node.metrics.overlap_ticks}
+        # Tick-phase profile (PR 8, obs/prof.py, default on —
+        # RAFTSQL_PROF=0 for the A/B): per-phase shares of tick time
+        # (fsync vs dispatch vs publish) + the p50/p95/p99 window, so
+        # the BENCH_*.json trajectory shows WHY a rung moved, not just
+        # that it did.
+        prof = getattr(node, "prof", None)
+        if prof is not None:
+            out["phase_profile"] = {**prof.shares(),
+                                    "phases": prof.snapshot()}
         gcw = getattr(node, "_gcwal", None)
         if gcw is not None:
             out["wal_group_commits"] = gcw.group_commits

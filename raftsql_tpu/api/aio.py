@@ -140,10 +140,23 @@ class _Conn(asyncio.Protocol):
                                         self.srv.rdb.render_health()
                                         .encode(), b"application/json"))
                     continue
-                if path == b"/metrics":
-                    payload = self.srv.rdb.render_metrics().encode()
-                    self.tr.write(_resp(200, b"OK", payload,
-                                        b"application/json"))
+                if path.partition(b"?")[0] == b"/metrics":
+                    # Prometheus negotiation, parity with api/http.py:
+                    # ?format=prom or an OpenMetrics Accept header.
+                    from raftsql_tpu.utils.metrics import (
+                        PROM_CONTENT_TYPE, wants_prom)
+                    if wants_prom(
+                            path.partition(b"?")[2].decode("latin-1"),
+                            headers.get("accept", "")):
+                        payload = self.srv.rdb.render_metrics_prom() \
+                            .encode()
+                        self.tr.write(_resp(
+                            200, b"OK", payload,
+                            PROM_CONTENT_TYPE.encode("latin-1")))
+                    else:
+                        payload = self.srv.rdb.render_metrics().encode()
+                        self.tr.write(_resp(200, b"OK", payload,
+                                            b"application/json"))
                     continue
                 if path in (b"/trace", b"/events"):
                     # Observability exports (raftsql_tpu/obs/): Chrome
@@ -188,6 +201,7 @@ class _Conn(asyncio.Protocol):
             group = b"0"
             linear = False
             token = None
+            accept = b""
             for line in head[1:]:
                 k, _, v = line.partition(b":")
                 k = k.strip().lower()
@@ -197,6 +211,9 @@ class _Conn(asyncio.Protocol):
                     group = v.strip()
                 elif k == b"x-consistency":
                     linear = v.strip().lower() == b"linear"
+                elif k == b"accept":
+                    # /metrics content negotiation (Prometheus text).
+                    accept = v.strip()
                 elif k == b"x-raft-retry-token":
                     # Hex u64 retry token: pins the proposal's envelope
                     # id so client re-sends apply exactly once.
@@ -213,7 +230,8 @@ class _Conn(asyncio.Protocol):
         body = bytes(buf[end + 4:total])
         del buf[:total]
         return method, path, {"group": group, "linear": linear,
-                              "token": token}, body
+                              "token": token,
+                              "accept": accept.decode("latin-1")}, body
 
     def _fail(self, msg: bytes) -> None:
         self.tr.write(_resp(400, b"Bad Request", msg))

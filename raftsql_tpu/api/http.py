@@ -102,10 +102,20 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(200, rdb.render_health().encode(),
                            ctype="application/json")
                 return
-            if self.path == "/metrics":
+            if self.path.partition("?")[0] == "/metrics":
+                # Content negotiation (utils/metrics.py wants_prom):
+                # ?format=prom or a Prometheus/OpenMetrics Accept
+                # header gets the text exposition; default stays JSON.
+                from raftsql_tpu.utils.metrics import (PROM_CONTENT_TYPE,
+                                                       wants_prom)
                 self._body()    # drain — a leftover body corrupts keep-alive
-                self._send(200, rdb.render_metrics().encode(),
-                           ctype="application/json")
+                if wants_prom(self.path.partition("?")[2],
+                              self.headers.get("Accept", "")):
+                    self._send(200, rdb.render_metrics_prom().encode(),
+                               ctype=PROM_CONTENT_TYPE)
+                else:
+                    self._send(200, rdb.render_metrics().encode(),
+                               ctype="application/json")
                 return
             if self.path == "/trace":
                 # Chrome trace-event JSON (Perfetto-loadable): the span

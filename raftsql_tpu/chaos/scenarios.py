@@ -512,7 +512,7 @@ class FusedChaosRunner:
             return
         FlightRecorder().dump(
             f"fused-seed{self.sched.seed}", repr(err),
-            tracer=node.tracer, ring=node.ring,
+            tracer=node.tracer, ring=node.ring, node=node,
             meta={"seed": self.sched.seed,
                   "schedule_digest": self.sched.digest(),
                   "report": dict(self.report)})

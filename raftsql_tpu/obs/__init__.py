@@ -20,15 +20,31 @@ chaos harness wires both planes into a flight recorder (`flight.py`):
 an invariant failure dumps the last N ticks of device events plus the
 host spans next to the failing seed.
 
-Everything here is OFF by default: the engine carries a `tracer`/`ring`
+TRACING is OFF by default: the engine carries a `tracer`/`ring`
 attribute that is None until `enable_tracing()` is called, and every
 hook is gated on that attribute — the disabled cost is one attribute
 test, and the fused scan signatures are untouched.
+
+The production TELEMETRY plane is ON by default (it is cheap enough to
+be): the tick-phase profiler (`prof.py` — per-phase p50/p95/p99 of
+where the tick's wall time goes, overlap-aware, RAFTSQL_PROF=0 to
+disable) and the per-group traffic accounting
+(utils/metrics.py GroupTraffic — `[G]` propose/commit/ack counters +
+EWMA rates feeding the /metrics top-K hot-groups table).  Both are
+pure observers: chaos digests are pinned bit-identical with them on.
+Cross-process trace SEGMENTS (`export.py TraceSegmentWriter`) let
+`--workers N` HTTP worker processes land on the engine's /trace as one
+merged multi-process Perfetto timeline.
 """
 from raftsql_tpu.obs.device_ring import EVENT_FIELDS, DeviceEventRing
-from raftsql_tpu.obs.export import chrome_trace, validate_chrome_trace
+from raftsql_tpu.obs.export import (TraceSegmentWriter, chrome_trace,
+                                    collect_segments,
+                                    validate_chrome_trace)
 from raftsql_tpu.obs.flight import FlightRecorder
+from raftsql_tpu.obs.prof import PROF_PHASES, TickPhaseProfiler
 from raftsql_tpu.obs.spans import SpanTracer
 
 __all__ = ["EVENT_FIELDS", "DeviceEventRing", "SpanTracer",
-           "chrome_trace", "validate_chrome_trace", "FlightRecorder"]
+           "chrome_trace", "validate_chrome_trace", "FlightRecorder",
+           "TickPhaseProfiler", "PROF_PHASES", "TraceSegmentWriter",
+           "collect_segments"]

@@ -12,7 +12,21 @@ One document, three track families:
   * pid 3 "device": counter ("C") tracks built from the device event
     ring — commit / inbox depth / vote tally per (peer, group) — on a
     SYNTHETIC tick axis (1 tick = `tick_us` microseconds), since device
-    ticks carry no wall clock.  Separate pid, so the axes never mix.
+    ticks carry no wall clock.  Separate pid, so the axes never mix;
+  * pid 4 "tick phases": the tick-phase profiler's per-phase duration
+    tracks (obs/prof.py — pop / dispatch / wal_write / fsync / publish
+    / ring_drain, one thread per (phase, worker id));
+  * real-pid process tracks: per-process trace SEGMENTS merged in from
+    the serving plane's worker processes (TraceSegmentWriter /
+    collect_segments below) — a `--workers N` deployment's /trace is
+    ONE multi-process Perfetto timeline, workers named and keyed by
+    their real OS pid.
+
+Cross-process timestamps work because Linux CLOCK_MONOTONIC is one
+boot-relative clock shared by every process on the host: segments
+store RAW monotonic stamps and chrome_trace rebases everything to one
+`base_monotonic` epoch (the engine tracer's, falling back to the
+profiler's).
 
 `validate_chrome_trace` is the schema check the tests (and `make
 trace`) run over every emitted document, so "Perfetto accepts it" is an
@@ -20,7 +34,12 @@ asserted property, not a hope.
 """
 from __future__ import annotations
 
+import glob
 import json
+import os
+import threading
+import time
+from collections import deque
 from typing import List, Optional
 
 from raftsql_tpu.obs.spans import PHASES
@@ -40,10 +59,18 @@ def _meta(pid: int, name: str, tid: Optional[int] = None,
 
 def chrome_trace(span_snapshot: Optional[dict] = None,
                  device_rows: Optional[List[dict]] = None,
-                 tick_us: float = 1000.0, max_groups: int = 8) -> dict:
+                 tick_us: float = 1000.0, max_groups: int = 8,
+                 phase_events: Optional[List[dict]] = None,
+                 process_segments: Optional[List[dict]] = None,
+                 base_monotonic: Optional[float] = None) -> dict:
     """Build the trace document from `SpanTracer.snapshot()` and/or
-    `DeviceEventRing.rows()`.  Either may be None/empty — the document
-    is always valid (an empty trace loads fine)."""
+    `DeviceEventRing.rows()`, plus the tick-phase profiler's
+    `events()` (`phase_events`) and per-process worker segments
+    (`process_segments`, see collect_segments).  Any input may be
+    None/empty — the document is always valid (an empty trace loads
+    fine).  `base_monotonic` is the raw-monotonic epoch phase/segment
+    stamps are rebased to (pass the span tracer's `t0` so every track
+    family shares one time axis)."""
     events: List[dict] = []
     events += _meta(1, "raftsql spans")
     seen_groups = set()
@@ -88,7 +115,127 @@ def chrome_trace(span_snapshot: Optional[dict] = None,
                             "ts": ts, "pid": 3, "tid": 0,
                             "args": {"value": row[field][p][g]}})
 
+    base = base_monotonic or 0.0
+
+    def _rel_us(raw_s: float) -> float:
+        return round(max((raw_s - base) * 1e6, 0.0), 1)
+
+    if phase_events:
+        events += _meta(4, "raftsql tick phases")
+        tids: dict = {}
+        for ev in phase_events:
+            key = (ev["phase"], ev.get("tid", 0))
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len(tids)
+                tname = ev["phase"] if not ev.get("tid") \
+                    else f"{ev['phase']} w{ev['tid']}"
+                events += _meta(4, "raftsql tick phases", tid=tid,
+                                tname=tname)[1:]
+            events.append({
+                "name": ev["phase"], "cat": "phase", "ph": "X",
+                "ts": _rel_us(ev["t0"]),
+                "dur": round(max(ev["dur"], 0.0) * 1e6, 1),
+                "pid": 4, "tid": tid, "args": {"tick": ev["tick"]}})
+
+    for seg in process_segments or ():
+        pid = int(seg.get("pid", 0))
+        if pid <= 4:        # never collide with the synthetic tracks
+            continue
+        events += _meta(pid, seg.get("name", f"pid {pid}"), tid=0,
+                        tname="requests")
+        for ev in seg.get("events", ()):
+            rec = {"name": ev["name"], "cat": "proc",
+                   "ts": _rel_us(ev["ts"]), "pid": pid,
+                   "tid": int(ev.get("tid", 0)),
+                   "args": ev.get("args", {})}
+            dur = ev.get("dur", 0.0)
+            if dur and dur > 0:
+                rec.update(ph="X", dur=round(dur * 1e6, 1))
+            else:
+                rec.update(ph="i", s="t")
+            events.append(rec)
+
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace segments (the --workers serving plane).
+
+
+class TraceSegmentWriter:
+    """Per-process trace segment: a bounded event ring a worker process
+    stamps (pid/worker-id tagged) and flushes ATOMICALLY (tmp + rename)
+    into the engine's ring directory, where the engine's /trace picks
+    it up (collect_segments) and merges it into the single Perfetto
+    timeline.  Timestamps are RAW monotonic seconds — one clock per
+    host, so the engine can rebase them onto its own trace epoch.
+
+    Bounded and crash-friendly: the ring caps memory, the atomic
+    rename means a reader never sees a torn file, and the last flushed
+    segment of a SIGKILLed worker stays on disk — its final moments
+    remain on the merged timeline."""
+
+    def __init__(self, dirname: str, name: str, tag: Optional[str] = None,
+                 cap: int = 4096, flush_s: float = 0.5):
+        os.makedirs(dirname, exist_ok=True)
+        self.name = name
+        self.pid = os.getpid()
+        self.path = os.path.join(dirname,
+                                 f"trace-seg-{tag or self.pid}.json")
+        self.flush_s = flush_s
+        self._events: deque = deque(maxlen=cap)
+        self._mu = threading.Lock()
+        self._dirty = False
+        self._last_flush = 0.0
+
+    def note(self, name: str, t_start: float, dur_s: float,
+             tid: int = 0, **args) -> None:
+        with self._mu:
+            self._events.append({"name": name, "ts": t_start,
+                                 "dur": dur_s, "tid": tid,
+                                 "args": args})
+            self._dirty = True
+
+    def maybe_flush(self) -> None:
+        """Flush when dirty and at least `flush_s` elapsed — cheap to
+        call after every completion batch."""
+        if self._dirty and time.monotonic() - self._last_flush \
+                >= self.flush_s:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._mu:
+            doc = {"pid": self.pid, "name": self.name,
+                   "events": list(self._events)}
+            self._dirty = False
+        self._last_flush = time.monotonic()
+        tmp = self.path + f".tmp{self.pid}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:       # diagnostics only — never fail the worker
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def collect_segments(dirname: str) -> List[dict]:
+    """Every flushed per-process trace segment under `dirname`
+    (unreadable/corrupt files skipped — a scrape must always render)."""
+    out: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(dirname,
+                                              "trace-seg-*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+            out.append(doc)
+    return out
 
 
 def validate_chrome_trace(doc: dict) -> None:

@@ -31,9 +31,18 @@ class FlightRecorder:
         self.last_ticks = last_ticks
 
     def dump(self, name: str, reason: str, tracer=None, ring=None,
-             meta: Optional[dict] = None) -> Optional[str]:
+             meta: Optional[dict] = None, node=None,
+             ring_server=None) -> Optional[str]:
         """Write flight-<name>.json; returns the path, or None if the
-        write failed (never raises — the invariant error must win)."""
+        write failed (never raises — the invariant error must win).
+
+        `node` (a ClusterHostPlane) adds the SERVING-PLANE state the
+        post-PR-7 stack crashes with: the double-buffered overlap
+        stash's status at crash time (was a durable phase in flight,
+        and for which tick?), the WAL group-commit batch histogram,
+        and the tick-phase profile.  `ring_server` (runtime/ring.py
+        RingServer) adds per-worker propose/completion ring cursors
+        and depths."""
         doc = {
             "reason": reason,
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -47,6 +56,11 @@ class FlightRecorder:
                 doc["device_events"] = ring.rows(last=self.last_ticks)
             if tracer is not None:
                 doc["host_spans"] = tracer.snapshot()
+            if node is not None:
+                doc["serving"] = self._serving_state(node)
+            if ring_server is not None:
+                doc.setdefault("serving", {})["rings"] = \
+                    ring_server.flight_doc()
         except Exception as e:      # noqa: BLE001 - diagnostics only
             doc["collect_error"] = repr(e)
         path = os.path.join(self.directory, f"flight-{name}.json")
@@ -59,3 +73,38 @@ class FlightRecorder:
             return None
         log.warning("flight-recorder dump: %s (%s)", path, reason)
         return path
+
+    @staticmethod
+    def _serving_state(node) -> dict:
+        """Serving-plane snapshot off a ClusterHostPlane (every field
+        getattr-guarded: older/foreign engines just contribute less)."""
+        out: dict = {}
+        stash = getattr(node, "_stash", None)
+        overlap = {"enabled": bool(getattr(node, "_overlap", False)),
+                   "stashed": stash is not None}
+        if stash is not None:
+            try:
+                _infos, staged, stick = stash
+                overlap["stash_tick"] = int(stick)
+                # Entries whose durable phase had NOT yet retired — the
+                # exact set a crash at this instant would lose.
+                overlap["stash_entries"] = int(sum(
+                    len(per_peer[4]) for step in staged
+                    for per_peer in step))
+            except Exception:       # noqa: BLE001 - diagnostics only
+                pass
+        out["overlap"] = overlap
+        gcw = getattr(node, "_gcwal", None)
+        if gcw is not None:
+            out["wal_group_commit"] = {
+                "group_commits": gcw.group_commits,
+                "batch_hist": {str(k): v for k, v in
+                               sorted(gcw.batch_hist.items())}}
+        prof = getattr(node, "prof", None)
+        if prof is not None:
+            out["phase_profile"] = prof.snapshot()
+        traffic = getattr(node, "traffic", None)
+        if traffic is not None:
+            out["group_traffic"] = traffic.doc(
+                leader_of=getattr(node, "leader_of", None))
+        return out

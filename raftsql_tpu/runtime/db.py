@@ -254,6 +254,11 @@ class RaftDB:
         tracer = self._node_tracer()
         if tracer is not None:
             tracer.note_ack(group, query)
+        # Per-group traffic accounting (utils/metrics.py GroupTraffic):
+        # the ack leg — proposes/commits are stamped in the host plane.
+        traffic = getattr(self.pipe.node, "traffic", None)
+        if traffic is not None:
+            traffic.add_ack(group)
         with self._mu:
             cbs = self._q2cb.get((group, query))
             if not cbs:
@@ -556,6 +561,23 @@ class RaftDB:
             v, l = node.cfg.num_peers * node.cfg.num_groups, 0
         m["members_voters"] = v
         m["members_learners"] = l
+        # Telemetry plane (PR 8, default on): per-phase tick wall-time
+        # histograms and the per-group traffic table with its top-K
+        # hot-groups rows — the feed the placement controller consumes.
+        prof = getattr(node, "prof", None)
+        if prof is not None:
+            m["phase_profile"] = prof.snapshot()
+        traffic = getattr(node, "traffic", None)
+        if traffic is not None:
+            m["group_traffic"] = traffic.doc(
+                leader_of=getattr(node, "leader_of", None),
+                shard_of=getattr(node, "_group_shard_of", None))
+        gcw = getattr(node, "_gcwal", None)
+        if gcw is not None:
+            # Group-commit batch histogram: peers coalesced per fsync
+            # -> count (how well the one-fsync-per-tick lever engages).
+            m["wal_gc_batch_hist"] = {
+                str(k): v for k, v in sorted(gcw.batch_hist.items())}
         if self.serving_metrics is not None:
             try:
                 m.update(self.serving_metrics())
@@ -565,6 +587,14 @@ class RaftDB:
 
     def render_metrics(self) -> str:
         return json.dumps(self.metrics(), sort_keys=True) + "\n"
+
+    def render_metrics_prom(self) -> str:
+        """GET /metrics?format=prom: the same document in the
+        Prometheus text exposition (utils/metrics.py prom_render —
+        every JSON counter/gauge/histogram becomes a sample; validated
+        by scripts/check_prom.py)."""
+        from raftsql_tpu.utils.metrics import prom_render
+        return prom_render(self.metrics())
 
     # -- membership admin (raftsql_tpu/membership/) ---------------------
 
@@ -624,20 +654,36 @@ class RaftDB:
 
     def trace_doc(self) -> dict:
         """Chrome trace-event JSON of the engine's span tracer + device
-        event ring (GET /trace; Perfetto-loadable).  Always a valid
-        (possibly empty) document — tracing off just yields no events."""
-        from raftsql_tpu.obs.export import chrome_trace
+        event ring + tick-phase profiler tracks + any worker-process
+        trace segments (GET /trace; Perfetto-loadable).  A `--workers N`
+        deployment's document is ONE multi-process timeline: the
+        engine's spans/phases plus each worker's pid-tagged request
+        segment (runtime/ring.py RingServer points
+        `trace_segments_dir` at the ring directory the workers flush
+        into).  Always a valid (possibly empty) document — tracing off
+        just yields no span events."""
+        from raftsql_tpu.obs.export import chrome_trace, collect_segments
         node = self.pipe.node
         tracer = self._node_tracer()
         ring = getattr(node, "ring", None)
+        prof = getattr(node, "prof", None)
         if ring is not None:
             ring.drain()
+        seg_dir = getattr(self, "trace_segments_dir", None)
+        segs = collect_segments(seg_dir) if seg_dir else None
+        # One time axis for every track family: the tracer's epoch when
+        # tracing is on, else the profiler's.
+        base = tracer.t0 if tracer is not None else (
+            prof.epoch if prof is not None else 0.0)
         # Cap the counter window: a long-lived ring (keep=4096 ticks)
         # would emit ~20 counter events per tick per (peer, group) —
         # the last 1024 ticks keep the document loadable.
         return chrome_trace(
             tracer.snapshot() if tracer is not None else None,
-            ring.rows(last=1024) if ring is not None else None)
+            ring.rows(last=1024) if ring is not None else None,
+            phase_events=prof.events() if prof is not None else None,
+            process_segments=segs,
+            base_monotonic=base)
 
     def events_doc(self, last: int = 256) -> dict:
         """Raw observability state (GET /events): the device ring's

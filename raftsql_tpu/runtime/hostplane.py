@@ -70,9 +70,10 @@ from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
 from raftsql_tpu.native.build import load_native_plog
 from raftsql_tpu.storage import fsio
 from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
+from raftsql_tpu.obs.prof import TickPhaseProfiler
 from raftsql_tpu.storage.wal import (WAL, split_uniform_runs,
                                      wal_exists, wal_mirror_all)
-from raftsql_tpu.utils.metrics import NodeMetrics
+from raftsql_tpu.utils.metrics import GroupTraffic, NodeMetrics
 
 _C = {n: i for i, n in enumerate(INFO_FIELDS)}
 
@@ -133,12 +134,35 @@ class ClusterHostPlane:
     # layout choice; the mesh runtime's ShardedWAL seams supersede it.
     supports_group_commit = True
 
+    # Which mesh shard owns a group (the hot-groups table's `shard`
+    # column); None on unsharded runtimes, a method on MeshClusterNode.
+    _group_shard_of = None
+
     def __init__(self, cfg: RaftConfig, data_dir: str,
                  seed: Optional[int] = None,
                  group_commit: Optional[bool] = None):
         P, G = cfg.num_peers, cfg.num_groups
         self.cfg = cfg
         self.metrics = NodeMetrics()
+        # Telemetry plane (raftsql_tpu/obs/prof.py), DEFAULT ON — both
+        # are pure observers (pre-allocated buffers, no allocation on
+        # the hot path, never any control-flow influence: chaos digests
+        # are pinned identical with RAFTSQL_PROF on and off).
+        #   prof: per-phase tick wall-time rings -> /metrics
+        #     phase_profile + Perfetto phase tracks in /trace
+        #     (RAFTSQL_PROF=0 off, RAFTSQL_PROF_SAMPLE=N 1-in-N ticks);
+        #   traffic: [G] propose/commit/ack counters + EWMA rates ->
+        #     /metrics group_traffic top-K hot-groups table.
+        self.prof = TickPhaseProfiler.from_env(G)
+        self.traffic = GroupTraffic(G)
+        # Overlap-aware phase attribution: the tick that OWNS the
+        # durable/publish work currently running (a stashed durable
+        # phase retiring inside tick t+1's dispatch window is tick
+        # t's).  _pending_tick tags the deferred-publish pinfo.
+        self._prof_tick = 0
+        self._pending_tick = 0
+        self._fsync_dur = np.zeros(P, np.float64)   # parallel-path syncs
+        self._fsync_span: Optional[tuple] = None    # (t0, dur) last tick
         self.dirs = [os.path.join(data_dir, f"p{i + 1}") for i in range(P)]
         # WAL group commit: multiplex all P peers' records into ONE
         # physical log (flat group id peer*G+g) so the durable barrier
@@ -782,11 +806,19 @@ class ClusterHostPlane:
                 # never hang) but publish nothing more: the CLOSED
                 # sentinel must stay the queues' last item.
                 if item is not None and self.error is None:
+                    pinfo, ptick = item
                     t0 = _t.monotonic()
-                    self._publish_shard(item, shard)
+                    self._publish_shard(pinfo, shard)
+                    dur = _t.monotonic() - t0
                     with self._metrics_mu:
-                        self.metrics.t_publish_ms += \
-                            (_t.monotonic() - t0) * 1e3
+                        self.metrics.t_publish_ms += dur * 1e3
+                    prof = self.prof
+                    if prof is not None and prof.sampled(ptick):
+                        # Per-shard publish workers tag their shard id
+                        # — the mesh runtime's N workers each get their
+                        # own Perfetto phase track.
+                        prof.record("publish", ptick, t0, dur,
+                                    tid=shard)
             except Exception as e:
                 self.error = e
                 for cq in self._commit_qs:
@@ -798,9 +830,12 @@ class ClusterHostPlane:
 
     def _enqueue_publish(self, pinfo: np.ndarray) -> None:
         """Hand a durable tick's packed info to every publish worker
-        (each delivers only its own group block)."""
+        (each delivers only its own group block).  The owning tick id
+        (`self._prof_tick`, set by the caller) rides the queue item so
+        the workers' publish phases attribute to the right tick."""
+        item = (pinfo, self._prof_tick)
         for q in self._pub_qs:
-            q.put(pinfo)
+            q.put(item)
 
     def publish_flush(self) -> None:
         """Block until every enqueued publish has been delivered (the
@@ -895,9 +930,14 @@ class ClusterHostPlane:
         it; publish always runs after the save of the tick it publishes.
         """
         import time as _t
+        prof = self.prof
+        prof_on = prof is not None and prof.sampled(self._tick_no)
         t0 = _t.monotonic()
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n(self._steps)
+        tb = _t.monotonic() if prof_on else t0
+        if prof_on:
+            prof.record("pop", self._tick_no, t0, tb - t0)
         ti = self.timer_inc
         if ti is not None:
             # Skew accounting: how far this tick's timer advances
@@ -917,6 +957,8 @@ class ClusterHostPlane:
                              self.states.votes, self.inboxes.v_type,
                              self.inboxes.a_type, self._applied)
         t1 = _t.monotonic()
+        if prof_on:
+            prof.record("dispatch", self._tick_no, tb, t1 - tb)
         # Double-buffered dispatch: the PREVIOUS tick's stashed durable
         # phase (WAL writes + fsync barrier + publish) runs HERE, inside
         # this dispatch's device window — tick t's disk time overlaps
@@ -932,12 +974,16 @@ class ClusterHostPlane:
         # plane runs concurrently with this whole tick); a 1-core host
         # delivers inline while the device computes.
         if self._pending_pinfo is not None:
+            self._prof_tick = self._pending_tick
             if self._host_parallel:
                 self._enqueue_publish(self._pending_pinfo)
             else:
                 tp = _t.monotonic()
                 self._publish(self._pending_pinfo)
-                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+                pdur = _t.monotonic() - tp
+                self.metrics.t_publish_ms += pdur * 1e3
+                if prof is not None and prof.sampled(self._pending_tick):
+                    prof.record("publish", self._pending_tick, tp, pdur)
             self._pending_pinfo = None
         t2 = _t.monotonic()
         if self.overlap_hook is not None:
@@ -955,6 +1001,10 @@ class ClusterHostPlane:
             pinfo = np.asarray(jax.device_get(pinfo_dev))  # [P,G,NCOLS]
             dev_busy = True
         t3 = _t.monotonic()
+        if prof_on:
+            # The readback is dispatch time too: the host blocks on the
+            # device completing this tick's program.
+            prof.record("dispatch", self._tick_no, t2b, t3 - t2b)
 
         # Multi-step dispatch (RAFTSQL_FUSED_STEPS > 1): packed info
         # arrives stacked [S, P, G, C]; the host replays its durable
@@ -973,7 +1023,11 @@ class ClusterHostPlane:
         # next _build_prop_n snapshot must see post-pop queue state —
         # that is what keeps the overlapped pipeline's trajectory
         # bit-identical to the serialized one.
+        ts0 = _t.monotonic() if prof_on else 0.0
         staged = [self._stage_ranges(pi) for pi in step_infos]
+        if prof_on:
+            prof.record("pop", self._tick_no, ts0,
+                        _t.monotonic() - ts0)
         # Content-derived activity signals (durable-independent so the
         # stash decision cannot change them): any append staged or
         # mirrored, or any hard state due to change.
@@ -1008,12 +1062,17 @@ class ClusterHostPlane:
         # Cold/parking ticks finish inline — deferring would add a
         # whole (possibly parked) tick of ack latency for no overlap.
         if self._overlap and self._spin_hot:
-            self._stash = (step_infos, staged)
+            # The stash remembers its ORIGINATING tick: when it retires
+            # inside the next dispatch window, its durable/publish
+            # phases are attributed to this tick, not the one that
+            # happens to host the work (overlap-aware profiling).
+            self._stash = (step_infos, staged, self._tick_no)
             self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
             self._tick_active = base_active
             self._tick_no += 1
             self.metrics.ticks += 1
             return
+        self._prof_tick = self._tick_no
         tick_active = self._finish_durable(step_infos, staged) \
             or tick_active
         base_active = base_active or tick_active
@@ -1036,11 +1095,14 @@ class ClusterHostPlane:
                 if delta <= self._inline_publish_max:
                     tp = _t.monotonic()
                     self._publish(pinfo)
-                    self.metrics.t_publish_ms += \
-                        (_t.monotonic() - tp) * 1e3
+                    pdur = _t.monotonic() - tp
+                    self.metrics.t_publish_ms += pdur * 1e3
+                    if prof_on:
+                        prof.record("publish", self._tick_no, tp, pdur)
                     self._pending_pinfo = None
                 else:
                     self._pending_pinfo = pinfo  # next tick overlaps
+                    self._pending_tick = self._tick_no
         else:
             # About to go quiet: deliver this tick's commits NOW (they
             # are fsynced above) instead of deferring to a next tick
@@ -1051,7 +1113,10 @@ class ClusterHostPlane:
             else:
                 tp = _t.monotonic()
                 self._publish(pinfo)
-                self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+                pdur = _t.monotonic() - tp
+                self.metrics.t_publish_ms += pdur * 1e3
+                if prof_on:
+                    prof.record("publish", self._tick_no, tp, pdur)
             self._pending_pinfo = None
         self._tick_active = base_active
         self.metrics.t_device_ms += ((t1 - t0) + (t3 - t2b)) * 1e3
@@ -1064,8 +1129,10 @@ class ClusterHostPlane:
         double-buffered pipeline's back half).  Caller order guarantees
         this precedes the NEXT durable phase and its publish."""
         import time as _t
-        step_infos, staged = self._stash
+        step_infos, staged, stick = self._stash
         self._stash = None
+        # Attribute the whole retired phase to its ORIGINATING tick.
+        self._prof_tick = stick
         self._finish_durable(step_infos, staged)
         pinfo = step_infos[-1]
         if self._host_parallel:
@@ -1073,7 +1140,11 @@ class ClusterHostPlane:
         else:
             tp = _t.monotonic()
             self._publish(pinfo)
-            self.metrics.t_publish_ms += (_t.monotonic() - tp) * 1e3
+            pdur = _t.monotonic() - tp
+            self.metrics.t_publish_ms += pdur * 1e3
+            prof = self.prof
+            if prof is not None and prof.sampled(stick):
+                prof.record("publish", stick, tp, pdur)
 
     def _drain_pipeline(self) -> None:
         """Retire any stashed durable phase (manual-tick callers: the
@@ -1086,8 +1157,16 @@ class ClusterHostPlane:
         """The whole durable back half for one dispatch: per-step
         durable phases (epoch-framed when multi-step), the epoch
         commit, and membership apply-at-commit.  Returns tick_active
-        (anything written)."""
+        (anything written).  Attributed to `self._prof_tick` (set by
+        the caller: the live tick inline, the originating tick when a
+        stash retires)."""
+        import time as _t
         pinfo = step_infos[-1]
+        prof = self.prof
+        ptick = self._prof_tick
+        prof_on = prof is not None and prof.sampled(ptick)
+        td0 = _t.monotonic() if prof_on else 0.0
+        self._fsync_span = None
         # Multi-step dispatches are epoch-framed (see _ensure_epoch_
         # begin / _commit_epoch): BEGIN lazily wraps each peer's first
         # write, END lands before its fsync, and the dispatch commits
@@ -1114,6 +1193,16 @@ class ClusterHostPlane:
             self._membership_advance(pinfo)
         if self._gcwal is not None:
             self.metrics.wal_group_commits = self._gcwal.group_commits
+        if prof_on and tick_active:
+            # wal_write = the durable back half minus the fsync barrier
+            # (the barrier was clocked where it ran, serial or across
+            # the per-peer workers — _durable_phases fills _fsync_span).
+            t_tot = _t.monotonic() - td0
+            fs = self._fsync_span
+            fdur = fs[1] if fs is not None else 0.0
+            prof.record("wal_write", ptick, td0, max(t_tot - fdur, 0.0))
+            if fs is not None:
+                prof.record("fsync", ptick, fs[0], fdur)
         return tick_active
 
     def _stage_ranges(self, pinfo: np.ndarray) -> list:
@@ -1182,6 +1271,9 @@ class ClusterHostPlane:
                     for (cg, cidx, cd) in confs:
                         self._conf_note(cg, cidx, cd)
                 self.metrics.proposals += int(acc[ags].sum())
+                # Per-group traffic: the accepted counts are already in
+                # hand per group — one vectorized add, no new walks.
+                self.traffic.add_propose(ags, acc[ags])
                 if traced:
                     # Append stamp + index binding, outside the lock.
                     for g, b0, batch in traced:
@@ -1315,6 +1407,8 @@ class ClusterHostPlane:
             for i, mp in enumerate(m_peer):
                 by_peer[mp].append(i)
 
+            import time as _t
+
             def _host_peer(p: int) -> bool:
                 idx = by_peer[p]
                 if idx:
@@ -1330,11 +1424,18 @@ class ClusterHostPlane:
                 changed = self._save_hard(p, pinfo)
                 if self._ep_begun[p]:
                     self.wals[p].epoch_mark(self._ep_no_this, end=True)
+                ts = _t.monotonic()
                 self.wals[p].sync()
+                self._fsync_dur[p] = _t.monotonic() - ts
                 return changed
 
+            tm0 = _t.monotonic()
             for act in self._sync_pool.map(_host_peer, range(P)):
                 tick_active = tick_active or act
+            # The barrier cost is max, not sum: the per-peer syncs ran
+            # concurrently on the pool (see _finish_durable's profiler
+            # attribution).
+            self._fsync_span = (tm0, float(self._fsync_dur[:P].max()))
         elif m_peer:
             for p in sorted(set(m_peer)):
                 self._ensure_epoch_begin(p)
@@ -1407,7 +1508,10 @@ class ClusterHostPlane:
             # (os.fsync and the native wal_sync both release the GIL),
             # so the barrier costs one fsync wall-time, not P.  A peer
             # with nothing pending returns immediately.
+            import time as _t
+            tf0 = _t.monotonic()
             list(self._sync_pool.map(lambda w: w.sync(), self.wals))
+            self._fsync_span = (tf0, _t.monotonic() - tf0)
         return tick_active
 
     def _scrub_conf(self, g: int, base: int, datas: list) -> list:
@@ -1455,8 +1559,9 @@ class ClusterHostPlane:
                 # Nobody consumes this peer's stream: advance the
                 # cursor without materializing anything.
                 if p == 0:
-                    self._note_commits(int(
-                        (commit[ready] - self._applied[p][ready]).sum()))
+                    deltas = commit[ready] - self._applied[p][ready]
+                    self.traffic.add_commit(ready, deltas)
+                    self._note_commits(int(deltas.sum()))
                 self._applied[p][ready] = commit[ready]
                 continue
             plog = self.plogs[p]
@@ -1470,8 +1575,9 @@ class ClusterHostPlane:
                     plog.handle, gl, [a + 1 for a in al],
                     [c - a for c, a in zip(cl, al)])
                 self._applied[p][ready] = commit[ready]
-                self._note_commits(int(
-                    (commit[ready] - np.asarray(al)).sum()))
+                deltas = commit[ready] - np.asarray(al)
+                self.traffic.add_commit(ready, deltas)
+                self._note_commits(int(deltas.sum()))
                 continue
             items = []
             if hasattr(plog, "read_groups"):
@@ -1500,8 +1606,9 @@ class ClusterHostPlane:
                 self._commit_qs[p].put((RAW_MANY, items))
             self._applied[p][ready] = commit[ready]
             if p == 0:
-                self._note_commits(int(
-                    (commit[ready] - np.asarray(al)).sum()))
+                deltas = commit[ready] - np.asarray(al)
+                self.traffic.add_commit(ready, deltas)
+                self._note_commits(int(deltas.sum()))
 
     # -- log compaction (SURVEY §5.4) -----------------------------------
 
@@ -1566,6 +1673,7 @@ class ClusterHostPlane:
         else:
             self._stash = None
         if self._pending_pinfo is not None:
+            self._prof_tick = self._pending_tick
             self._enqueue_publish(self._pending_pinfo)  # already durable
             self._pending_pinfo = None
         for q in self._pub_qs:

@@ -344,6 +344,13 @@ class MeshClusterNode(ClusterHostPlane):
         self._sharded_step = make_sharded_cluster_step_host(self.cfg,
                                                             self.mesh)
 
+    def _group_shard_of(self, group: int) -> int:
+        """Which mesh group shard owns `group` — the `shard` column of
+        the /metrics hot-groups table, so the placement story (ROADMAP:
+        traffic-aware leadership migration) can see which device shard
+        a hot group's load lands on before deciding to move it."""
+        return group // self._g_loc
+
     # -- host-plane seams (runtime/hostplane.py) ------------------------
 
     def _new_wal(self, dirname: str) -> ShardedWAL:

@@ -241,6 +241,11 @@ class SpscRing:
         invert)."""
         return max(0, self._load(_OFF_TAIL) - self._load(_OFF_HEAD))
 
+    def cursors(self) -> Tuple[int, int]:
+        """Raw (head, tail) byte cursors — the flight recorder's view
+        of where each side of the ring stood at crash time."""
+        return self._load(_OFF_HEAD), self._load(_OFF_TAIL)
+
     def close(self) -> None:
         self._view.release()
         self._mm.close()
@@ -348,6 +353,14 @@ class RingServer:
         # RaftDB.metrics via the serving_metrics hook).
         if hasattr(rdb, "serving_metrics"):
             rdb.serving_metrics = self.metrics
+        # Cross-process trace merge: workers flush per-process trace
+        # segments into the ring directory; pointing the engine's
+        # RaftDB at it makes GET /trace one multi-process timeline.
+        rdb.trace_segments_dir = dirname
+        # Ring-drain phase profiling rides the engine's tick-phase
+        # profiler (obs/prof.py) when the engine exposes one.
+        self._prof_node = getattr(getattr(rdb, "pipe", None), "node",
+                                  None)
 
     def start(self) -> None:
         for t in self._threads:
@@ -368,6 +381,22 @@ class RingServer:
             "ring_deduped": self.deduped,
             "ring_depth": sum(r.depth_bytes() for r in self._req),
         }
+
+    def flight_doc(self) -> dict:
+        """Serving-plane state for a chaos flight bundle
+        (obs/flight.py): the counters plus every worker's raw ring
+        cursors/depths — where each producer and consumer stood at
+        crash time."""
+        rings = []
+        for i in range(self.workers):
+            rh, rt = self._req[i].cursors()
+            ch, ct = self._cpl[i].cursors()
+            rings.append({"worker": i,
+                          "req_head": rh, "req_tail": rt,
+                          "req_depth": max(0, rt - rh),
+                          "cpl_head": ch, "cpl_tail": ct,
+                          "cpl_depth": max(0, ct - ch)})
+        return {"counters": self.metrics(), "rings": rings}
 
     # -- completion path (any engine thread) ----------------------------
 
@@ -529,6 +558,7 @@ class RingServer:
         last = time.monotonic()
         while not self._stop.is_set():
             worked = False
+            t_b0 = time.monotonic()
             while True:
                 view = ring.pop()
                 if view is None:
@@ -556,6 +586,15 @@ class RingServer:
                                    self._err_body(e))
             if worked:
                 last = time.monotonic()
+                # ring_drain phase sample (obs/prof.py): how long this
+                # batch of popped requests took to hand off, tagged
+                # with the worker id it drained.
+                prof = getattr(self._prof_node, "prof", None)
+                if prof is not None:
+                    tick = int(getattr(self._prof_node, "_tick_no", 0))
+                    if prof.sampled(tick):
+                        prof.record("ring_drain", tick, t_b0,
+                                    last - t_b0, tid=worker)
             else:
                 delay = _spin_wait(last)
                 if delay:
@@ -584,7 +623,7 @@ class RingClient:
     """
 
     def __init__(self, dirname: str, worker: int,
-                 attach_timeout_s: float = 60.0):
+                 attach_timeout_s: float = 60.0, trace: bool = False):
         req_p, cpl_p = ring_paths(dirname, worker)
         deadline = time.monotonic() + attach_timeout_s
         while True:
@@ -602,12 +641,27 @@ class RingClient:
         self._pending: Dict[int, "RingFuture"] = {}
         self._stop = threading.Event()
         self.error: Optional[Exception] = None      # facade parity
+        # Cross-process trace merge (--trace): this worker stamps each
+        # ring round trip (submit -> completion, pid/worker-id tagged)
+        # into a per-process segment file under the ring dir; the
+        # engine's /trace merges every segment into ONE multi-process
+        # Perfetto timeline (obs/export.py TraceSegmentWriter).
+        self._obs = None
+        self._t0s: Dict[int, Tuple[float, str]] = {}
+        if trace:
+            from raftsql_tpu.obs.export import TraceSegmentWriter
+            self._obs = TraceSegmentWriter(
+                dirname, f"http worker {worker}",
+                tag=f"w{worker}-{os.getpid()}")
         self._consumer = threading.Thread(
             target=self._consume, daemon=True,
             name=f"ring-cpl-{worker}")
         self._consumer.start()
 
     # -- plumbing --------------------------------------------------------
+
+    _OP_NAMES = {OP_PUT: "ring.put", OP_GET: "ring.get",
+                 OP_DOC: "ring.doc", OP_MEMBER: "ring.member"}
 
     def _submit(self, op: int, group: int, flags: int, token: int,
                 body: bytes, deadline_s: float = 2.0) -> "RingFuture":
@@ -616,6 +670,12 @@ class RingClient:
             req_id = self._next_id
             self._next_id += 1
             self._pending[req_id] = fut
+            if self._obs is not None:
+                # Submit stamp: the span closes when the completion
+                # pops (the client-visible ring round trip — HTTP
+                # parse happened just before, the ack rides after).
+                self._t0s[req_id] = (time.monotonic(),
+                                     self._OP_NAMES.get(op, "ring.op"))
             ok = self._req.push(encode_request(op, req_id, group, flags,
                                                token, body))
         if not ok:
@@ -648,8 +708,16 @@ class RingClient:
                 fut = self._pending.pop(req_id, None)
                 if fut is not None:
                     fut._resolve(status, leader, body)
+                if self._obs is not None:
+                    got = self._t0s.pop(req_id, None)
+                    if got is not None:
+                        now = time.monotonic()
+                        self._obs.note(got[1], got[0], now - got[0],
+                                       tid=0, status=status)
             if worked:
                 last = time.monotonic()
+                if self._obs is not None:
+                    self._obs.maybe_flush()
             else:
                 delay = _spin_wait(last)
                 if delay:
@@ -658,6 +726,8 @@ class RingClient:
     def close(self) -> None:
         self._stop.set()
         self._consumer.join(timeout=2)
+        if self._obs is not None:
+            self._obs.flush()       # the segment file outlives us
         self._req.close()
         self._cpl.close()
 
@@ -714,6 +784,13 @@ class RingClient:
 
     def render_metrics(self) -> str:
         return self._doc("metrics")
+
+    def render_metrics_prom(self) -> str:
+        """Prometheus exposition at a worker: fetch the engine's JSON
+        document over the ring and render locally — same mapping as
+        RaftDB.render_metrics_prom, no new ring op."""
+        from raftsql_tpu.utils.metrics import prom_render
+        return prom_render(json.loads(self._doc("metrics")))
 
     def render_health(self) -> str:
         return self._doc("health")

@@ -412,6 +412,7 @@ def _serve_workers(rdb, args) -> None:
             [sys.executable, "-m", "raftsql_tpu.server.worker",
              "--rings", ring_dir, "--index", str(i),
              "--port", str(args.port)]
+            + (["--trace"] if args.trace else [])
             + (["--verbose"] if args.verbose else []),
             env=env, preexec_fn=_die_with_parent)
 

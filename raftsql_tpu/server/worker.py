@@ -29,6 +29,11 @@ def main(argv=None) -> None:
                     help="worker index (selects the ring pair)")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--trace", action="store_true",
+                    help="stamp each ring round trip into a per-process "
+                         "trace segment (pid/worker-id tagged) the "
+                         "engine's /trace merges into one Perfetto "
+                         "timeline")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -42,7 +47,7 @@ def main(argv=None) -> None:
     from raftsql_tpu.api.aio import AioSQLServer
     from raftsql_tpu.runtime.ring import RingClient
 
-    rdb = RingClient(args.rings, args.index)
+    rdb = RingClient(args.rings, args.index, trace=args.trace)
     srv = AioSQLServer(args.port, rdb, timeout_s=args.timeout,
                        reuse_port=True)
 

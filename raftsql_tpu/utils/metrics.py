@@ -7,9 +7,13 @@ the transport (reference raft.go:167-176) and never reads them; SURVEY.md
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 
 @dataclass
@@ -103,6 +107,245 @@ class NodeMetrics:
                 "publish": round(self.t_publish_ms / t, 4),
             },
         }
+
+
+class GroupTraffic:
+    """Host-side `[G]` propose/commit/ack counters + EWMA rates — the
+    per-group traffic feed for `GET /metrics` (`group_traffic`) and the
+    future placement controller (ROADMAP: traffic-aware leadership
+    migration needs per-group propose rates to find hot groups).
+
+    Counters are stamped where the host plane already walks per-group
+    structures (runtime/hostplane.py: `_stage_ranges` for proposals,
+    `_publish_shard` for commits; runtime/db.py `_ack_one` for acks) —
+    one vectorized `np.add.at` per tick, no new device work.  Commit
+    updates arrive from per-shard publish workers over DISJOINT group
+    blocks, so the unsynchronized adds never race on an element.  Rates
+    are EWMA'd lazily at scrape time (nothing on the tick path)."""
+
+    def __init__(self, num_groups: int, alpha: float = 0.3,
+                 top_k: int = 10):
+        G = num_groups
+        self.num_groups = G
+        self.proposed = np.zeros(G, np.int64)
+        self.committed = np.zeros(G, np.int64)
+        self.acked = np.zeros(G, np.int64)
+        self.top_k = int(os.environ.get("RAFTSQL_METRICS_TOPK", top_k))
+        self._alpha = alpha
+        self._rate_p = np.zeros(G)
+        self._rate_c = np.zeros(G)
+        self._last_p = np.zeros(G, np.int64)
+        self._last_c = np.zeros(G, np.int64)
+        self._last_t = time.monotonic()
+        self._mu = threading.Lock()
+
+    # -- hot path (tick thread / publish workers / commit consumer) ----
+
+    def add_propose(self, groups, counts) -> None:
+        np.add.at(self.proposed, groups, counts)
+
+    def add_commit(self, groups, counts) -> None:
+        np.add.at(self.committed, groups, counts)
+
+    def add_ack(self, group: int) -> None:
+        self.acked[group] += 1
+
+    # -- scrape path ----------------------------------------------------
+
+    def _advance_rates_locked(self) -> None:
+        now = time.monotonic()
+        dt = now - self._last_t
+        if dt < 0.05:       # back-to-back scrapes: keep the last window
+            return
+        inst_p = (self.proposed - self._last_p) / dt
+        inst_c = (self.committed - self._last_c) / dt
+        a = self._alpha
+        self._rate_p += a * (inst_p - self._rate_p)
+        self._rate_c += a * (inst_c - self._rate_c)
+        self._last_p = self.proposed.copy()
+        self._last_c = self.committed.copy()
+        self._last_t = now
+
+    def doc(self, leader_of=None, shard_of=None,
+            k: Optional[int] = None) -> dict:
+        """Aggregate totals + the top-K hot-groups table
+        (group id, 1-based leader, EWMA propose/commit rates, raw
+        totals; a `shard` column on sharded runtimes so the placement
+        story can move hot groups between shards)."""
+        with self._mu:
+            self._advance_rates_locked()
+            rp = self._rate_p.copy()
+            rc = self._rate_c.copy()
+        k = min(k if k is not None else self.top_k, self.num_groups)
+        # Rate-first ranking with the all-time totals as tie-breaker
+        # (a scrape before any rate window still ranks by volume).
+        order = np.lexsort((-self.proposed, -rp))[:k]
+        hot: List[dict] = []
+        for g in order.tolist():
+            if not (self.proposed[g] or self.committed[g]
+                    or rp[g] > 0):
+                continue
+            row = {"group": g,
+                   "leader": (int(leader_of(g)) + 1
+                              if leader_of is not None else 0),
+                   "propose_rate": round(float(rp[g]), 3),
+                   "commit_rate": round(float(rc[g]), 3),
+                   "proposed": int(self.proposed[g]),
+                   "committed": int(self.committed[g]),
+                   "acked": int(self.acked[g])}
+            if callable(shard_of):
+                row["shard"] = int(shard_of(g))
+            hot.append(row)
+        return {"proposed": int(self.proposed.sum()),
+                "committed": int(self.committed.sum()),
+                "acked": int(self.acked.sum()),
+                "hot_groups": hot}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (GET /metrics?format=prom).
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def wants_prom(query: str, accept: str) -> bool:
+    """Content negotiation for GET /metrics: `?format=prom` wins, else
+    an Accept header asking for the Prometheus text exposition
+    (`application/openmetrics-text` or `text/plain; version=0.0.4`)."""
+    if "format=prom" in (query or ""):
+        return True
+    a = (accept or "").lower()
+    return "openmetrics" in a or "version=0.0.4" in a
+
+
+def _prom_name(s: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in s)
+
+
+def _prom_label_value(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def prom_samples(doc: dict, prefix: str = "raftsql"
+                 ) -> List[Tuple[str, Dict[str, str], float]]:
+    """Flatten a metrics() JSON document into Prometheus samples
+    [(name, labels, value)].  One mapping owns both the exposition and
+    the round-trip check (scripts/check_prom.py): every numeric leaf of
+    the JSON becomes exactly one sample.
+
+      * nested dicts join with `_` (faults.crashes ->
+        raftsql_faults_crashes);
+      * dicts keyed by digit strings become bucket-labeled samples
+        (wal_gc_batch_hist -> raftsql_wal_gc_batch_hist{bucket="3"});
+      * `phase_profile` becomes the summary raftsql_tick_phase_ms
+        {phase=...,quantile=...} + _count/_sum/_max series;
+      * `group_traffic.hot_groups` rows become raftsql_group_<field>
+        {group=...,leader=...[,shard=...]} gauges;
+      * None / NaN / strings are skipped (a scrape must always render).
+    """
+    out: List[Tuple[str, Dict[str, str], float]] = []
+
+    def num(v):
+        if isinstance(v, bool):
+            return float(v)
+        if isinstance(v, (int, float)) and v == v:
+            return float(v)
+        return None
+
+    def add(name, labels, v):
+        fv = num(v)
+        if fv is not None:
+            out.append((name, labels, fv))
+
+    def walk(obj, name):
+        if isinstance(obj, dict):
+            if obj and all(isinstance(k, str) and k.lstrip("-").isdigit()
+                           for k in obj) \
+                    and all(num(v) is not None for v in obj.values()):
+                for k, v in sorted(obj.items(), key=lambda kv: int(kv[0])):
+                    add(name, {"bucket": k}, v)
+                return
+            for k, v in obj.items():
+                walk(v, f"{name}_{_prom_name(k)}")
+        else:
+            add(name, {}, obj)
+
+    for key, val in doc.items():
+        if key == "phase_profile" and isinstance(val, dict):
+            base = f"{prefix}_tick_phase_ms"
+            for phase, st in val.items():
+                if not isinstance(st, dict):
+                    add(f"{prefix}_phase_profile_{_prom_name(phase)}",
+                        {}, st)
+                    continue
+                lab = {"phase": phase}
+                for q, f in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                             ("0.99", "p99_ms")):
+                    if f in st:
+                        add(base, {**lab, "quantile": q}, st[f])
+                add(f"{base}_count", lab, st.get("n"))
+                add(f"{base}_sum", lab, st.get("total_ms"))
+                # max is not a summary-family suffix: standalone gauge.
+                add(f"{prefix}_tick_phase_max_ms", lab,
+                    st.get("max_ms"))
+            continue
+        if key == "group_traffic" and isinstance(val, dict):
+            for k, v in val.items():
+                if k != "hot_groups":
+                    add(f"{prefix}_group_traffic_{_prom_name(k)}", {}, v)
+            for row in val.get("hot_groups", ()):
+                lab = {"group": str(row.get("group"))}
+                if "leader" in row:
+                    lab["leader"] = str(row["leader"])
+                if "shard" in row:
+                    lab["shard"] = str(row["shard"])
+                for f, v in row.items():
+                    if f in ("group", "leader", "shard"):
+                        continue
+                    add(f"{prefix}_group_{_prom_name(f)}", lab, v)
+            continue
+        walk(val, f"{prefix}_{_prom_name(key)}")
+    return out
+
+
+def prom_render(doc: dict, prefix: str = "raftsql") -> str:
+    """The Prometheus text exposition of a metrics() document: samples
+    grouped per metric name behind one # HELP/# TYPE pair (the format
+    requires a metric's samples contiguous), gauges throughout except
+    the tick-phase summary."""
+    samples = prom_samples(doc, prefix)
+    grouped: "Dict[str, List[Tuple[Dict[str, str], float]]]" = {}
+    order: List[str] = []
+    for name, labels, value in samples:
+        if name not in grouped:
+            grouped[name] = []
+            order.append(name)
+        grouped[name].append((labels, value))
+    summary = f"{prefix}_tick_phase_ms"
+    lines: List[str] = []
+    for name in order:
+        if name in (summary + "_count", summary + "_sum"):
+            # Part of the summary family declared at `summary` — the
+            # exposition format forbids a second TYPE for them.
+            pass
+        else:
+            lines.append(f"# HELP {name} raftsql metric {name}")
+            lines.append(f"# TYPE {name} "
+                         + ("summary" if name == summary else "gauge"))
+        for labels, value in grouped[name]:
+            lab = ""
+            if labels:
+                lab = "{" + ",".join(
+                    f'{_prom_name(k)}="{_prom_label_value(v)}"'
+                    for k, v in labels.items()) + "}"
+            if value == int(value) and abs(value) < 2 ** 53:
+                sval = str(int(value))
+            else:
+                sval = repr(value)
+            lines.append(f"{name}{lab} {sval}")
+    return "\n".join(lines) + "\n"
 
 
 class LatencyTimer:

@@ -1,0 +1,296 @@
+"""Metrics lint: scrape a live node's Prometheus exposition and
+validate it under a strict parser.
+
+Two modes:
+
+  * `--url http://host:port` — scrape an already-running node;
+  * no arguments (CI default) — boot a `--fused` server per HTTP plane
+    (aio and threaded), drive a couple of PUTs through it, then scrape.
+
+Per scraped node it checks:
+
+  1. `GET /metrics?format=prom` parses under `parse_prom` below — a
+     deliberately strict reading of the Prometheus text exposition
+     format (metric/label name charsets, TYPE-before-samples, samples
+     of one metric contiguous, no duplicate series, parsable values);
+  2. `Accept: application/openmetrics-text` negotiation returns the
+     same exposition and the right Content-Type;
+  3. ROUND TRIP: every numeric leaf of the JSON `GET /metrics`
+     document appears as a sample (same shared mapping —
+     raftsql_tpu/utils/metrics.py prom_samples — so a field added to
+     the JSON can never silently miss the exposition);
+  4. a few load-bearing series are present: the per-group top-K
+     (`raftsql_group_propose_rate`), the tick-phase summary
+     (`raftsql_tick_phase_ms`), and the core counters.
+
+tests/test_obs.py imports `parse_prom` so the in-process tests and
+this live-node lint enforce the same grammar.  Exit 0 = clean.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import re
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, FrozenSet, List, Tuple
+
+_METRIC_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})?"
+    r" (?P<value>\S+)$")
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+SampleKey = Tuple[str, FrozenSet[Tuple[str, str]]]
+
+
+def _family(name: str) -> str:
+    """The metric family a sample line belongs to (summary/histogram
+    child series share the parent's TYPE declaration)."""
+    for suffix in ("_count", "_sum", "_bucket"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def parse_prom(text: str) -> Dict[SampleKey, float]:
+    """Strictly parse a Prometheus text exposition; raises ValueError
+    with the offending line on any format violation.  Returns
+    {(name, frozenset(labels.items())): value}."""
+    if not text.endswith("\n"):
+        raise ValueError("exposition must end with a newline")
+    samples: Dict[SampleKey, float] = {}
+    typed: Dict[str, str] = {}
+    current_family: str = ""
+    seen_families: set = set()
+    for lineno, line in enumerate(text.split("\n")[:-1], 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or parts[0] != "#" \
+                    or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {lineno}: malformed comment "
+                                 f"{line!r}")
+            name = parts[2]
+            if not _METRIC_RE.match(name):
+                raise ValueError(f"line {lineno}: bad metric name "
+                                 f"{name!r}")
+            if parts[1] == "TYPE":
+                if parts[3] not in _TYPES:
+                    raise ValueError(f"line {lineno}: unknown type "
+                                     f"{parts[3]!r}")
+                if name in typed:
+                    raise ValueError(f"line {lineno}: duplicate TYPE "
+                                     f"for {name}")
+                typed[name] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name = m.group("name")
+        fam = _family(name)
+        decl = fam if fam in typed else name
+        if decl not in typed:
+            raise ValueError(f"line {lineno}: sample {name} has no "
+                             "preceding TYPE declaration")
+        # Samples of one family must be contiguous.
+        if decl != current_family:
+            if decl in seen_families:
+                raise ValueError(f"line {lineno}: samples of {decl} "
+                                 "are not contiguous")
+            seen_families.add(decl)
+            current_family = decl
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw is not None:
+            stripped = _LABEL_PAIR_RE.sub("", raw)
+            if stripped.strip(", ") != "":
+                raise ValueError(f"line {lineno}: malformed labels "
+                                 f"{raw!r}")
+            for k, v in _LABEL_PAIR_RE.findall(raw):
+                if not _LABEL_RE.match(k):
+                    raise ValueError(f"line {lineno}: bad label name "
+                                     f"{k!r}")
+                if k in labels:
+                    raise ValueError(f"line {lineno}: duplicate label "
+                                     f"{k!r}")
+                labels[k] = v
+        sval = m.group("value")
+        try:
+            value = float(sval)
+        except ValueError:
+            raise ValueError(f"line {lineno}: unparsable value "
+                             f"{sval!r}") from None
+        key = (name, frozenset(labels.items()))
+        if key in samples:
+            raise ValueError(f"line {lineno}: duplicate series "
+                             f"{name}{sorted(labels.items())}")
+        samples[key] = value
+    return samples
+
+
+def check_round_trip(json_doc: dict, samples: Dict[SampleKey, float]
+                     ) -> List[str]:
+    """Every numeric JSON leaf must have a sample (names + labels; the
+    value may have moved between the two scrapes)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from raftsql_tpu.utils.metrics import prom_samples
+    missing = []
+    for name, labels, _value in prom_samples(json_doc):
+        if (name, frozenset(labels.items())) not in samples:
+            missing.append(f"{name}{sorted(labels.items())}")
+    return missing
+
+
+# ---------------------------------------------------------------------------
+# Live-node scraping.
+
+
+def _get(host: str, port: int, path: str, headers=None,
+         timeout: float = 10.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read().decode("utf-8")
+    finally:
+        conn.close()
+
+
+def lint_url(host: str, port: int, label: str = "") -> None:
+    tag = label or f"{host}:{port}"
+    status, _h, json_text = _get(host, port, "/metrics")
+    assert status == 200, (tag, status)
+    json_doc = json.loads(json_text)
+
+    status, hdrs, prom_text = _get(host, port, "/metrics?format=prom")
+    assert status == 200, (tag, status)
+    ctype = {k.lower(): v for k, v in hdrs.items()}.get(
+        "content-type", "")
+    assert ctype.startswith("text/plain"), (tag, ctype)
+    samples = parse_prom(prom_text)
+    assert samples, f"{tag}: empty exposition"
+
+    # Accept-header negotiation must serve the same exposition.
+    status, hdrs, nego = _get(
+        host, port, "/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    assert status == 200, (tag, status)
+    parse_prom(nego)
+
+    missing = check_round_trip(json_doc, samples)
+    assert not missing, (f"{tag}: {len(missing)} JSON fields missing "
+                         f"from the exposition, e.g. {missing[:5]}")
+
+    for required in ("raftsql_ticks", "raftsql_commits",
+                     "raftsql_faults_crashes"):
+        assert any(n == required for (n, _l) in samples), \
+            f"{tag}: required series {required} absent"
+    print(f"check_prom: {tag}: OK ({len(samples)} series, "
+          f"{len(prom_text.splitlines())} lines)")
+
+
+def lint_fused_server(engine: str) -> None:
+    """Boot one --fused server on HTTP plane `engine`, drive writes
+    (so per-group traffic and phase histograms are live), scrape and
+    validate both exposition paths."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = tempfile.mkdtemp(prefix=f"check-prom-{engine}-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(os.path.join(tmp, "server.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
+         "--port", str(port), "--groups", "2", "--tick", "0.005",
+         "--http-engine", engine],
+        cwd=tmp, env=env, stdout=logf, stderr=logf)
+    try:
+        deadline = time.monotonic() + 90
+        while True:
+            if proc.poll() is not None or time.monotonic() > deadline:
+                with open(os.path.join(tmp, "server.log")) as f:
+                    raise RuntimeError(
+                        f"server ({engine}) not ready; log tail: "
+                        + f.read()[-800:])
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=5)
+                conn.request("PUT", "/",
+                             body=b"CREATE TABLE IF NOT EXISTS "
+                                  b"t (v text)")
+                if conn.getresponse().status in (204, 400):
+                    conn.close()
+                    break
+                conn.close()
+            except OSError:
+                pass
+            time.sleep(0.3)
+        def put(body: str, group: int) -> int:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            try:
+                conn.request("PUT", "/", body=body.encode(),
+                             headers={"X-Raft-Group": str(group)})
+                r = conn.getresponse()
+                r.read()
+                return r.status
+            finally:
+                conn.close()
+
+        for g in range(2):      # schema per raft group
+            assert put("CREATE TABLE IF NOT EXISTS t (v text)",
+                       g) == 204
+        for i in range(8):
+            assert put(f"INSERT INTO t (v) VALUES ('{i}')",
+                       i % 2) == 204
+        lint_url("127.0.0.1", port, label=f"fused/{engine}")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:                               # noqa: BLE001
+            proc.kill()
+        logf.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Prometheus exposition lint for raftsql /metrics")
+    ap.add_argument("--url", action="append", default=[],
+                    help="scrape this base URL (http://host:port) "
+                         "instead of booting fused servers")
+    args = ap.parse_args(argv)
+    if args.url:
+        for u in args.url:
+            m = re.match(r"https?://([^:/]+):(\d+)", u)
+            if not m:
+                print(f"check_prom: bad url {u}", file=sys.stderr)
+                return 2
+            lint_url(m.group(1), int(m.group(2)))
+        return 0
+    # CI default: both HTTP planes, one fused boot each.
+    for engine in ("aio", "threaded"):
+        lint_fused_server(engine)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,25 @@ def test_durable_child_micro():
     assert set(phases) == {"stage", "device", "wal", "send", "publish"}
 
 
+def test_durable_fused_child_records_phase_profile():
+    """The durable fused rung's extras must carry the tick-phase
+    profile summary (fsync/dispatch/publish shares + histograms) so
+    the BENCH_*.json trajectory shows WHY a rung moved."""
+    r, out = run_bench({
+        "BENCH_CHILD": "1", "BENCH_PLATFORM": "cpu",
+        "BENCH_CONFIG": "durable", "BENCH_DURABLE_MODE": "fused",
+        "BENCH_GROUPS": "32", "BENCH_TICKS": "8",
+        "BENCH_REPEATS": "1", "BENCH_E": "8"})
+    assert r.returncode == 0, r.stderr[-800:]
+    assert out["value"] > 0
+    pp = out["phase_profile"]
+    assert {"fsync_share", "dispatch_share", "publish_share"} <= set(pp)
+    shares = sum(v for k, v in pp.items() if k.endswith("_share"))
+    assert 0.99 <= shares <= 1.01, pp
+    assert "fsync" in pp["phases"], pp["phases"]
+    assert "p99_ms" in pp["phases"]["fsync"]
+
+
 def test_parent_recovers_tunnel_on_late_reprobe(tmp_path):
     """VERDICT r3 task 8 (the round-3 failure mode): both early probes
     hang, but the tunnel recovers mid-budget — the late re-probe must

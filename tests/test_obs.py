@@ -1,16 +1,27 @@
 """Observability subsystem (raftsql_tpu/obs/): device-plane event
 ring, host-plane lifecycle spans, Chrome-trace (Perfetto) export, the
 /trace and /events HTTP endpoints, the propose→commit histograms in
-/metrics, and the chaos flight recorder.
+/metrics, and the chaos flight recorder — plus the PR 8 production
+telemetry plane: the tick-phase profiler (overlap-aware attribution),
+per-group traffic accounting (top-K hot groups), the Prometheus text
+exposition on both HTTP planes, and the cross-process /trace merge of
+a --workers deployment.
 
 The schema checks here ARE the acceptance gate for "Perfetto accepts
 the emitted JSON": validate_chrome_trace enforces the trace-event
 object form (name/ph/ts/pid, X needs dur, C needs numeric args) that
-both Perfetto and chrome://tracing require.
+both Perfetto and chrome://tracing require; scripts/check_prom.py's
+parse_prom is the same gate for the Prometheus exposition.
 """
 import http.client
+import importlib.util
 import json
 import os
+import signal
+import socket
+import subprocess
+import sys
+import time
 
 import pytest
 
@@ -280,6 +291,259 @@ def test_metrics_exports_membership_state(server):
     assert m["members_voters"] == 2
     assert m["members_learners"] == 0
     assert m["conf_changes_applied"] == 0
+
+
+# -- production telemetry plane (PR 8) ---------------------------------
+
+
+def _load_check_prom():
+    """scripts/check_prom.py as a module: the tests and the CI lint
+    must enforce the exact same exposition grammar."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_prom", os.path.join(repo, "scripts", "check_prom.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_prom_exposition_parses_and_round_trips(server):
+    """GET /metrics?format=prom (and Accept negotiation) on both HTTP
+    planes: parses under the strict parser, and every numeric field of
+    the JSON document appears as a sample (name + labels)."""
+    for i in range(3):
+        code = _put(server, b"CREATE TABLE IF NOT EXISTS main.p (v text)"
+                    if i == 0 else
+                    f'INSERT INTO main.p (v) VALUES ("{i}")'.encode())
+        assert code == 204
+    check_prom = _load_check_prom()
+    status, data = _get(server, "/metrics")
+    assert status == 200
+    json_doc = json.loads(data)
+    status, prom = _get(server, "/metrics?format=prom")
+    assert status == 200
+    samples = check_prom.parse_prom(prom.decode())
+    assert samples
+    missing = check_prom.check_round_trip(json_doc, samples)
+    assert not missing, missing[:10]
+    # Accept-header negotiation returns the exposition with the prom
+    # content type; the bare GET stays JSON.
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=10)
+    try:
+        conn.request("GET", "/metrics",
+                     headers={"Accept": "application/openmetrics-text"})
+        r = conn.getresponse()
+        body = r.read().decode()
+        assert r.status == 200
+        assert (r.getheader("Content-Type") or "").startswith(
+            "text/plain")
+        check_prom.parse_prom(body)
+    finally:
+        conn.close()
+    json.loads(_get(server, "/metrics")[1])     # default unchanged
+
+
+def test_per_group_traffic_ranks_hot_group_first(tmp_path):
+    """A deliberately skewed workload: the hot group must rank first
+    in the top-K table with matching counters and its live leader."""
+    node = FusedClusterNode(mkcfg(groups=4), str(tmp_path))
+    try:
+        elect(node)
+        node.propose_many(2, [f"SET h{i} v".encode()
+                              for i in range(40)])
+        node.propose_many(0, [b"SET cold 1"])
+        for _ in range(40):
+            node.tick()
+        node.publish_flush()
+        doc = node.traffic.doc(leader_of=node.leader_of)
+        assert doc["proposed"] == 41
+        hot = doc["hot_groups"]
+        assert hot[0]["group"] == 2, hot
+        assert hot[0]["proposed"] == 40
+        assert hot[0]["committed"] >= 40        # +fresh-leader no-op
+        assert hot[0]["leader"] == node.leader_of(2) + 1
+        assert hot[0]["propose_rate"] >= hot[-1]["propose_rate"]
+        cold = [r for r in hot if r["group"] == 0]
+        assert cold and cold[0]["proposed"] == 1
+    finally:
+        node.stop()
+
+
+def test_profiler_attribution_matches_across_overlap_modes(
+        tmp_path, monkeypatch):
+    """Overlap-aware attribution: a stashed durable phase that retires
+    inside tick t+1's dispatch window belongs to tick t.  The SAME
+    deterministic workload must therefore yield the SAME set of
+    fsync/wal_write-owning ticks with RAFTSQL_OVERLAP_DISPATCH on and
+    off (naive record-where-it-ran attribution shifts every hot tick
+    by one)."""
+    results = {}
+    for overlap in ("1", "0"):
+        monkeypatch.setenv("RAFTSQL_OVERLAP_DISPATCH", overlap)
+        node = FusedClusterNode(mkcfg(groups=2),
+                                str(tmp_path / f"ov{overlap}"))
+        try:
+            assert node.prof is not None        # default ON
+            elect(node)
+            for i in range(6):
+                node.propose_many(0, [f"SET a{i} v".encode()])
+                node.tick()
+            for _ in range(6):
+                node.tick()
+            node.publish_flush()                # retires any stash
+            results[overlap] = {
+                "fsync": node.prof.phase_ticks("fsync"),
+                "wal": node.prof.phase_ticks("wal_write"),
+                "overlap_ticks": node.metrics.overlap_ticks,
+            }
+        finally:
+            node.stop()
+    assert results["1"]["overlap_ticks"] > 0    # the pipeline engaged
+    assert results["0"]["overlap_ticks"] == 0
+    assert results["1"]["fsync"] == results["0"]["fsync"]
+    assert results["1"]["wal"] == results["0"]["wal"]
+
+
+def test_phase_tracks_in_trace_doc(traced_node):
+    """The profiler's phase events land as pid-4 Perfetto tracks next
+    to the span/device tracks, on one shared time axis."""
+    node = traced_node
+    elect(node)
+    node.propose_many(0, [b"SET k v"])
+    for _ in range(10):
+        node.tick()
+    node.publish_flush()
+    doc = chrome_trace(node.tracer.snapshot(),
+                       phase_events=node.prof.events(),
+                       base_monotonic=node.tracer.t0)
+    validate_chrome_trace(doc)
+    phases = [e for e in doc["traceEvents"]
+              if e.get("pid") == 4 and e.get("ph") == "X"]
+    assert {e["name"] for e in phases} >= {"dispatch", "fsync"}
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in phases)
+
+
+def test_flight_bundle_carries_serving_state(tmp_path):
+    """Flight bundles now carry the PR 7 serving-plane state: overlap
+    stash status at crash time, the group-commit batch histogram, and
+    per-worker ring cursors/depths."""
+    from raftsql_tpu.obs.flight import FlightRecorder
+    from raftsql_tpu.runtime.ring import RingServer
+
+    node = FusedClusterNode(mkcfg(groups=2), str(tmp_path / "d"),
+                            group_commit=True)
+    rs = None
+    try:
+        elect(node)
+        node.propose_many(0, [b"SET x 1", b"SET y 2"])
+        node.tick()     # hot tick: the overlap pipeline stashes
+        assert node._stash is not None
+
+        class _Rdb:
+            serving_metrics = None
+
+        rs = RingServer(_Rdb(), str(tmp_path / "rings"), workers=2)
+        rs.start()
+        path = FlightRecorder(str(tmp_path / "flights")).dump(
+            "serving-unit", "unit-test", node=node, ring_server=rs)
+        with open(path) as f:
+            doc = json.load(f)
+        s = doc["serving"]
+        assert s["overlap"]["enabled"] is True
+        assert s["overlap"]["stashed"] is True
+        assert isinstance(s["overlap"]["stash_tick"], int)
+        assert s["overlap"]["stash_entries"] >= 2
+        assert s["wal_group_commit"]["group_commits"] >= 1
+        assert isinstance(s["wal_group_commit"]["batch_hist"], dict)
+        assert "phase_profile" in s and "group_traffic" in s
+        rings = s["rings"]["rings"]
+        assert len(rings) == 2
+        assert all(r["req_tail"] >= r["req_head"] for r in rings)
+    finally:
+        if rs is not None:
+            rs.stop()
+        node.stop()
+
+
+def test_workers_trace_merge_multiprocess(tmp_path):
+    """--fused --workers 2 --trace: the engine's GET /trace is ONE
+    merged Perfetto timeline carrying spans from all three pids (the
+    engine plus both worker processes), and the prom exposition works
+    through a worker's ring facade."""
+    from raftsql_tpu.api.client import RaftSQLClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
+         "--workers", "2", "--groups", "2", "--port", str(port),
+         "--tick", "0.004", "--trace"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    client = RaftSQLClient([port], timeout_s=10)
+
+    def healthz_fresh_conn():
+        # A FRESH connection per request: SO_REUSEPORT hashes the
+        # 4-tuple, so new ephemeral ports spread across both workers.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/healthz")
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+
+    try:
+        client.wait_healthy(0, deadline_s=90)
+        for g in range(2):
+            client.put("CREATE TABLE t (v text)", group=g,
+                       deadline_s=60)
+        for i in range(10):
+            client.put(f"INSERT INTO t (v) VALUES ('w{i}')",
+                       group=i % 2, deadline_s=30)
+        for _ in range(15):
+            healthz_fresh_conn()
+        # Segment flush cadence is 0.5 s after a completion batch:
+        # wait it out, then drive one more round so both workers flush
+        # everything above.
+        time.sleep(0.8)
+        for _ in range(15):
+            healthz_fresh_conn()
+        status, _, text = client.raw(0, "GET", "/trace")
+        assert status == 200
+        doc = json.loads(text)
+        validate_chrome_trace(doc)
+        evs = doc["traceEvents"]
+        worker_pids = {e["pid"] for e in evs
+                       if e.get("ph") == "M"
+                       and e.get("name") == "process_name"
+                       and "http worker" in e["args"].get("name", "")}
+        assert len(worker_pids) == 2, worker_pids
+        for pid in worker_pids:
+            assert any(e.get("pid") == pid and e.get("ph") == "X"
+                       for e in evs), f"no spans from worker pid {pid}"
+        # Engine-side tracks on the same timeline: proposal spans
+        # (pid 1) and the profiler's phase tracks (pid 4).
+        assert any(e.get("pid") == 1 and e.get("ph") == "X"
+                   for e in evs)
+        assert any(e.get("pid") == 4 and e.get("ph") == "X"
+                   for e in evs)
+        # Prom exposition through a worker's RingClient facade.
+        status, _, prom = client.raw(0, "GET", "/metrics?format=prom")
+        assert status == 200
+        _load_check_prom().parse_prom(prom)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        client.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
 
 
 # -- flight recorder ---------------------------------------------------
