@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh trace prom-lint clean
+	chaos-mesh chaos-reads trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -86,6 +86,19 @@ chaos-mesh:
 chaos-membership:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --family membership --seed $(SEED)
+
+# Read-plane nemesis (raftsql_tpu/chaos/): lease / ReadIndex /
+# session / follower reads racing writes under clock skew, asymmetric
+# partitions, leader kills and crashes — the fused family run twice
+# and digest-compared, the LEASE-FALSIFICATION sensitivity pair (a
+# deliberately mis-sized lease bound under 4x skew MUST be caught by
+# the read-linearizability invariant; the same schedule with a correct
+# bound must pass), and the process-plane read nemesis over real
+# server processes (verdict digests compared).
+#   make chaos-reads SEED=17
+chaos-reads:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --reads --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

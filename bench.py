@@ -298,6 +298,109 @@ def _light_row(sweep: dict) -> dict:
                 {})
 
 
+def bench_reads(peers: int = 3, seconds: float = 2.0) -> tuple:
+    """BENCH_CONFIG=reads: the read-plane ladder on the DISTRIBUTED
+    runtime (3 RaftNodes over loopback — the plane where a ReadIndex
+    round actually costs a quorum round trip, unlike the co-located
+    fused cluster where leadership is process-local):
+
+      local       stale local read (reference parity)
+      lease       linearizable via the leader lease (no quorum round)
+      read_index  linearizable via the full ReadIndex round
+      session     watermark read at the leader (applied >= wm)
+      follower    replicated-watermark read at a follower
+
+    Headline = lease reads/s (the optimization under test); the whole
+    ladder rides the extras.  One serial client — this measures
+    per-read PATH cost, not parallel throughput."""
+    import tempfile
+
+    from raftsql_tpu.config import RaftConfig
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+    from raftsql_tpu.runtime.db import RaftDB
+    from raftsql_tpu.runtime.pipe import RaftPipe
+    from raftsql_tpu.transport.loopback import (LoopbackHub,
+                                                LoopbackTransport)
+
+    cfg = RaftConfig(num_groups=1, num_peers=peers,
+                     tick_interval_s=0.0005, election_ticks=40,
+                     heartbeat_ticks=4, log_window=64,
+                     max_entries_per_msg=8,
+                     lease_ticks=20, max_clock_skew=2)
+    rates: dict = {}
+    with tempfile.TemporaryDirectory(prefix="raftsql-bench-reads-") as d:
+        hub = LoopbackHub()
+        dbs = []
+        for i in range(peers):
+            pipe = RaftPipe.create(
+                i + 1, peers, cfg, LoopbackTransport(hub),
+                data_dir=os.path.join(d, f"raftsql-{i + 1}"))
+            dbs.append(RaftDB(
+                lambda g, i=i: SQLiteStateMachine(
+                    os.path.join(d, f"db-{i}.db")),
+                pipe, num_groups=1))
+        try:
+            assert dbs[0].propose(
+                "CREATE TABLE t (v text)").wait(30.0) is None
+            assert dbs[0].propose(
+                "INSERT INTO t (v) VALUES ('x')").wait(30.0) is None
+            deadline = time.monotonic() + 30.0
+            lead = None
+            while lead is None and time.monotonic() < deadline:
+                lead = next((i for i, db in enumerate(dbs)
+                             if db.pipe.node._last_role[0] == 2), None)
+                if lead is None:
+                    time.sleep(0.02)
+            if lead is None:
+                raise RuntimeError("no leader elected")
+            ldb = dbs[lead]
+            fdb = dbs[(lead + 1) % peers]
+            sel = "SELECT count(*) FROM t"
+            wm = ldb.watermark(0)
+
+            def timed(fn) -> float:
+                fn()                      # warm (lease round, caches)
+                n = 0
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < seconds:
+                    fn()
+                    n += 1
+                return n / (time.monotonic() - t0)
+
+            rates["local"] = round(timed(lambda: ldb.query(sel)), 1)
+            rates["lease"] = round(timed(
+                lambda: ldb.query(sel, mode="linear")), 1)
+            # Same path with the lease fast path disabled (the seam the
+            # engine itself uses when cfg.lease_ticks == 0): every read
+            # pays the full quorum round.
+            node = ldb.pipe.node
+            saved = node.lease_read
+            node.lease_read = lambda g: None
+            try:
+                rates["read_index"] = round(timed(
+                    lambda: ldb.query(sel, mode="linear")), 1)
+            finally:
+                node.lease_read = saved
+            rates["session"] = round(timed(
+                lambda: ldb.query(sel, mode="session", watermark=wm)),
+                1)
+            rates["follower"] = round(timed(
+                lambda: fdb.query(sel, mode="follower")), 1)
+            m = node.metrics
+            extras = {"reads_ladder": rates,
+                      "lease_grants": m.lease_grants,
+                      "lease_expiries": m.lease_expiries,
+                      "lease_degrades": m.lease_degrades}
+            _log(f"reads ladder: {rates}")
+            return float(rates["lease"]), extras
+        finally:
+            for db in dbs:
+                try:
+                    db.close()
+                except Exception:                   # noqa: BLE001
+                    pass
+
+
 def bench_latency_sweep(groups: int, peers: int, repeats: int) -> dict:
     """Propose→commit latency at light / half / saturating load.
 
@@ -1371,6 +1474,10 @@ def run_config(config: str, cpu: bool):
     if config == "latency":
         sweep = bench_latency_sweep(groups, peers, repeats)
         return (_light_row(sweep).get("p50_ms") or 0.0, {"lat": sweep})
+    if config == "reads":
+        return bench_reads(
+            peers, seconds=float(os.environ.get("BENCH_READ_SECONDS",
+                                                "2")))
     if config == "http":
         # Two rungs: 16 clients (the reference's concurrency scale,
         # raftsql_test.go:79-90 — a LATENCY point) and a high-concurrency

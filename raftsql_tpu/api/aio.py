@@ -55,6 +55,15 @@ def _resp(code: int, reason: bytes, body: bytes = b"",
     return b"\r\n".join(head) + b"\r\n" + body
 
 
+def _session_extra(rdb, group: int) -> tuple:
+    """X-Raft-Session commit-watermark echo as a `_resp` extra-header
+    tuple (advisory — a failed gauge read never fails the request)."""
+    try:
+        return ((b"X-Raft-Session", str(rdb.watermark(group)).encode()),)
+    except Exception:                                   # noqa: BLE001
+        return ()
+
+
 class _AckBridge:
     """Batch cross-thread ack delivery into the event loop.
 
@@ -199,7 +208,8 @@ class _Conn(asyncio.Protocol):
             method, path, _version = head[0].split(b" ", 2)
             clen = 0
             group = b"0"
-            linear = False
+            mode = "local"
+            session = 0
             token = None
             accept = b""
             for line in head[1:]:
@@ -210,7 +220,13 @@ class _Conn(asyncio.Protocol):
                 elif k == b"x-raft-group":
                     group = v.strip()
                 elif k == b"x-consistency":
-                    linear = v.strip().lower() == b"linear"
+                    # Read mode: local (default) / session / follower
+                    # / linear — README read-modes table.
+                    mode = v.strip().lower().decode("latin-1") or "local"
+                elif k == b"x-raft-session":
+                    # Session watermark: the commit-watermark echo a
+                    # previous response carried (read-your-writes).
+                    session = int(v.strip() or 0)
                 elif k == b"accept":
                     # /metrics content negotiation (Prometheus text).
                     accept = v.strip()
@@ -229,8 +245,8 @@ class _Conn(asyncio.Protocol):
             return None
         body = bytes(buf[end + 4:total])
         del buf[:total]
-        return method, path, {"group": group, "linear": linear,
-                              "token": token,
+        return method, path, {"group": group, "mode": mode,
+                              "session": session, "token": token,
                               "accept": accept.decode("latin-1")}, body
 
     def _fail(self, msg: bytes) -> None:
@@ -290,7 +306,17 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(400, b"Bad Request",
                                (str(err) + "\n").encode()))
         else:
-            self._finish(_204)
+            # Commit-watermark echo (X-Raft-Session): the ack implies
+            # local apply, so this watermark covers the write — a
+            # session read presenting it gets read-your-writes at any
+            # replica.
+            extra = _session_extra(rdb, group)
+            if extra:
+                self._finish(b"HTTP/1.1 204 No Content\r\n"
+                             + extra[0][0] + b": " + extra[0][1]
+                             + b"\r\n\r\n")
+            else:
+                self._finish(_204)
 
     async def _do_members(self, body: bytes) -> None:
         """POST /members — membership admin write, parity with
@@ -330,12 +356,13 @@ class _Conn(asyncio.Protocol):
                                (str(e) + "\n").encode()))
             return
         try:
-            # Reads block (SQLite, and linear reads wait out a quorum
-            # round + apply) — keep them off the loop thread.
+            # Reads block (SQLite, and linear/session reads wait out a
+            # quorum round or a watermark) — off the loop thread.
             rows = await self.srv.loop.run_in_executor(
                 self.srv._read_pool, lambda: rdb.query(
-                    query, group, linear=headers["linear"],
-                    timeout=self.srv.timeout_s))
+                    query, group, timeout=self.srv.timeout_s,
+                    mode=headers["mode"],
+                    watermark=headers["session"]))
         except NotLeaderError as e:
             extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
                 if e.leader > 0 else ()
@@ -351,7 +378,8 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(400, b"Bad Request",
                                (str(e) + "\n").encode()))
             return
-        self._finish(_resp(200, b"OK", rows.encode("utf-8")))
+        self._finish(_resp(200, b"OK", rows.encode("utf-8"),
+                           extra=_session_extra(rdb, group)))
 
 
 class AioSQLServer:

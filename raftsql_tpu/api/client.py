@@ -234,14 +234,25 @@ class RaftSQLClient:
 
     # -- public API ----------------------------------------------------
 
+    @staticmethod
+    def _session_of(hdrs: dict) -> Optional[int]:
+        wm = hdrs.get("X-Raft-Session")
+        if wm is not None and wm.isdigit():
+            return int(wm)
+        return None
+
     def put(self, sql: str, group: int = 0, node: Optional[int] = None,
             deadline_s: float = 60.0,
-            token: Optional[int] = None) -> None:
+            token: Optional[int] = None) -> Optional[int]:
         """Write SQL through consensus; returns once SOME attempt was
         acked (204).  Safe to retry past acceptance: every attempt
         carries the same retry token, so duplicates collapse server-side
         to one apply.  400 raises SQLError immediately (deterministic);
-        everything else retries until the deadline."""
+        everything else retries until the deadline.
+
+        Returns the acking node's X-Raft-Session commit watermark
+        (None on older servers): present it on a `consistency="session"`
+        get() to read-your-write from ANY replica."""
         token = secrets.randbits(64) if token is None else token
         headers = {"X-Raft-Retry-Token": f"{token:016x}"}
         if group:
@@ -258,7 +269,7 @@ class RaftSQLClient:
                     last = e
                     continue
                 if status == 204:
-                    return
+                    return self._session_of(hdrs)
                 if status == 400:
                     raise SQLError(status, text)
                 if status == 421:
@@ -272,14 +283,36 @@ class RaftSQLClient:
                     f"deadline; last={last!r}")
 
     def get(self, sql: str, group: int = 0, node: Optional[int] = None,
-            linear: bool = False, deadline_s: float = 60.0) -> str:
-        """Read SQL (idempotent — free to retry).  linear=True asks for
-        a linearizable read; 421 redirects chase X-Raft-Leader."""
+            linear: bool = False, deadline_s: float = 60.0,
+            consistency: Optional[str] = None,
+            session: int = 0) -> str:
+        """Read SQL (idempotent — free to retry).  `consistency` picks
+        the read mode (local/session/follower/linear; linear=True is
+        shorthand for "linear"); `session` carries the X-Raft-Session
+        watermark a previous response returned.  421 redirects chase
+        X-Raft-Leader."""
+        return self.get_session(sql, group=group, node=node,
+                                linear=linear, deadline_s=deadline_s,
+                                consistency=consistency,
+                                session=session)[0]
+
+    def get_session(self, sql: str, group: int = 0,
+                    node: Optional[int] = None, linear: bool = False,
+                    deadline_s: float = 60.0,
+                    consistency: Optional[str] = None,
+                    session: int = 0) -> Tuple[str, Optional[int]]:
+        """get(), returning (rows, response watermark): the watermark
+        is the serving replica's X-Raft-Session echo — carry the max
+        of these into later session reads for monotonic reads."""
         headers = {}
         if group:
             headers["X-Raft-Group"] = str(group)
-        if linear:
-            headers["X-Consistency"] = "linear"
+        if consistency is None and linear:
+            consistency = "linear"
+        if consistency and consistency != "local":
+            headers["X-Consistency"] = consistency
+        if session > 0:
+            headers["X-Raft-Session"] = str(session)
         deadline = time.monotonic() + deadline_s
         attempt = 0
         last: object = None
@@ -292,7 +325,7 @@ class RaftSQLClient:
                     last = e
                     continue
                 if status == 200:
-                    return text
+                    return text, self._session_of(hdrs)
                 if status == 400:
                     raise SQLError(status, text)
                 if status == 421:

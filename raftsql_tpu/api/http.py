@@ -32,6 +32,16 @@ from raftsql_tpu.runtime.db import NotLeaderError, RaftDB
 log = logging.getLogger("raftsql_tpu.http")
 
 
+def _session_headers(rdb, group: int) -> Optional[dict]:
+    """X-Raft-Session commit-watermark echo (session reads / read-your-
+    writes).  A watermark is advisory — never fail a served request
+    over a failed gauge read."""
+    try:
+        return {"X-Raft-Session": str(rdb.watermark(group))}
+    except Exception:                                   # noqa: BLE001
+        return None
+
+
 def _make_handler(rdb: RaftDB, timeout_s: float):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -91,7 +101,10 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             if err is not None:
                 self._err(err)
             else:
-                self._send(204)
+                # The ack implies local apply: the watermark echoed
+                # here covers this very write (X-Raft-Session —
+                # present it on a session read for read-your-writes).
+                self._send(204, headers=_session_headers(rdb, group))
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -138,10 +151,17 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                            ctype="application/json")
                 return
             try:
-                linear = (self.headers.get("X-Consistency", "")
-                          .lower() == "linear")
-                rows = rdb.query(self._body(), self._group(),
-                                 linear=linear, timeout=timeout_s)
+                # X-Consistency selects the read mode (README
+                # read-modes table): local (default) / session /
+                # follower / linear.  X-Raft-Session carries the
+                # session watermark (the commit-watermark echo a
+                # previous response returned).
+                mode = (self.headers.get("X-Consistency", "")
+                        .lower() or "local")
+                wm = int(self.headers.get("X-Raft-Session") or 0)
+                group = self._group()
+                rows = rdb.query(self._body(), group, timeout=timeout_s,
+                                 mode=mode, watermark=wm)
             except NotLeaderError as e:
                 # 421 Misdirected Request + the leader hint: the client
                 # retries its linearizable read against that node.
@@ -157,7 +177,10 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             except Exception as e:
                 self._err(e)
                 return
-            self._send(200, rows.encode("utf-8"))
+            # Commit-watermark echo: the client's next session read
+            # presents this to get read-your-writes anywhere.
+            self._send(200, rows.encode("utf-8"),
+                       headers=_session_headers(rdb, group))
 
         def _method_not_allowed(self):
             self._body()    # drain — a leftover body corrupts keep-alive

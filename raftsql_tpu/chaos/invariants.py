@@ -232,6 +232,67 @@ class RemovedQuorumSafety:
                 self._fully_removed.setdefault(g, set()).update(excluded)
 
 
+class SessionConsistency:
+    """Read-your-writes / monotonic reads for WATERMARK-carrying reads
+    (the session/follower read modes): a read presenting watermark `w`
+    on key k must return a committed write to k at log index >= the
+    newest committed write to k at-or-below w — i.e. at least as fresh
+    as everything the watermark covers.  Weaker than linearizability
+    (a session read may legally miss writes committed after w), which
+    is exactly why these modes get their own checker instead of the
+    register rule.
+
+    The committed write history arrives via note_commit(group, index,
+    key, value) from whatever apply stream the runner trusts (unique
+    values, like the register checker).  Thread-safe.
+    """
+
+    def __init__(self):
+        import threading
+        self._mu = threading.Lock()
+        # key -> sorted-ish list of (global_order, value); value -> ord.
+        self._by_key: Dict[Tuple[int, str], List[Tuple[int, str]]] = {}
+        self._ord: Dict[str, Tuple[int, int]] = {}  # value -> (g, idx)
+        self.reads_checked = 0
+
+    def note_commit(self, group: int, index: int, key: str,
+                    value: str) -> None:
+        with self._mu:
+            self._by_key.setdefault((group, key), []).append(
+                (index, value))
+            self._ord[value] = (group, index)
+
+    def check_read(self, group: int, key: str, watermark: int,
+                   value: str, mode: str = "session") -> None:
+        """`value` came back from a read of `key` carrying `watermark`
+        (a commit index of `group`)."""
+        with self._mu:
+            self.reads_checked += 1
+            hist = self._by_key.get((group, key), ())
+            floor = 0
+            floor_val = None
+            for (idx, v) in hist:
+                if idx <= watermark and idx > floor:
+                    floor, floor_val = idx, v
+            if floor_val is None:
+                return               # watermark predates every write
+            if value == "":
+                raise InvariantViolation(
+                    f"{mode} read(g{group} {key!r}, wm={watermark}) "
+                    f"returned the initial value but {floor_val!r} "
+                    f"committed at index {floor} <= wm")
+            got = self._ord.get(value)
+            if got is None or got[0] != group:
+                raise InvariantViolation(
+                    f"{mode} read(g{group} {key!r}) returned a value "
+                    f"never committed to that key: {value!r}")
+            if got[1] < floor:
+                raise InvariantViolation(
+                    f"{mode} read(g{group} {key!r}, wm={watermark}) "
+                    f"returned STALE {value!r} (index {got[1]}) — "
+                    f"{floor_val!r} committed at {floor} <= wm")
+
+
 class RegisterLinearizability:
     """Per-key register linearizability over completed PUT/GET history.
 
@@ -260,11 +321,23 @@ class RegisterLinearizability:
     """
 
     def __init__(self):
+        import threading
+        # One lock serializes the logical clock and every history
+        # mutation: the process-plane read nemesis drives this checker
+        # from concurrent client threads, where an unlocked clock
+        # could order two racing ops identically and mask (or invent)
+        # a precedence edge.  Single-threaded runners pay one
+        # uncontended acquire per op.
+        self._mu = threading.Lock()
         self._clock = 0
         self._writes: Dict[str, list] = {}   # value -> [key, inv, resp]
         # key -> [(inv, resp), ...] of COMPLETED writes.
         self._completed: Dict[str, List[Tuple[int, int]]] = {}
         self.reads_checked = 0
+        # Per read MODE accounting (lease/read_index/session/follower/
+        # linear/...): the nemesis report proves every family actually
+        # exercised the invariant.
+        self.reads_by_mode: Dict[str, int] = {}
 
     def _tick(self) -> int:
         self._clock += 1
@@ -273,48 +346,58 @@ class RegisterLinearizability:
     # -- write lifecycle -----------------------------------------------
 
     def begin_write(self, key: str, value: str) -> None:
-        if value in self._writes:
-            raise ValueError(f"write values must be unique: {value!r}")
-        self._writes[value] = [key, self._tick(), None]
+        with self._mu:
+            if value in self._writes:
+                raise ValueError(
+                    f"write values must be unique: {value!r}")
+            self._writes[value] = [key, self._tick(), None]
 
     def end_write(self, value: str) -> None:
-        w = self._writes.get(value)
-        if w is None or w[2] is not None:
-            return                       # unknown or already completed
-        w[2] = self._tick()
-        self._completed.setdefault(w[0], []).append((w[1], w[2]))
+        with self._mu:
+            w = self._writes.get(value)
+            if w is None or w[2] is not None:
+                return                   # unknown or already completed
+            w[2] = self._tick()
+            self._completed.setdefault(w[0], []).append((w[1], w[2]))
 
     # -- read lifecycle ------------------------------------------------
 
-    def begin_read(self, key: str) -> Tuple[str, int]:
-        return key, self._tick()
+    def begin_read(self, key: str, mode: str = "linear"
+                   ) -> Tuple[str, int, str]:
+        with self._mu:
+            return key, self._tick(), mode
 
-    def end_read(self, handle: Tuple[str, int], value: str) -> None:
-        key, inv = handle
-        resp = self._tick()
-        self.reads_checked += 1
-        completed = self._completed.get(key, ())
-        if value == "":
-            for (i2, r2) in completed:
-                if r2 <= inv:
-                    raise InvariantViolation(
-                        f"read({key!r}) returned the initial value "
-                        f"after a write completed before it")
-            return
-        w = self._writes.get(value)
-        if w is None or w[0] != key:
-            raise InvariantViolation(
-                f"read({key!r}) returned a value never written to "
-                f"that key: {value!r}")
-        _, w_inv, w_resp = w
-        if w_inv > resp:
-            raise InvariantViolation(
-                f"read({key!r}) returned {value!r} invoked after the "
-                f"read's response")
-        if w_resp is not None:
-            for (i2, r2) in completed:
-                if r2 <= inv and w_resp <= i2:
-                    raise InvariantViolation(
-                        f"read({key!r}) returned stale value "
-                        f"{value!r}: a later write completed before "
-                        f"the read began")
+    def end_read(self, handle, value: str) -> None:
+        key, inv, mode = (handle if len(handle) == 3
+                          else (*handle, "linear"))
+        with self._mu:
+            resp = self._tick()
+            self.reads_checked += 1
+            self.reads_by_mode[mode] = self.reads_by_mode.get(mode,
+                                                              0) + 1
+            completed = self._completed.get(key, ())
+            if value == "":
+                for (i2, r2) in completed:
+                    if r2 <= inv:
+                        raise InvariantViolation(
+                            f"{mode} read({key!r}) returned the "
+                            f"initial value after a write completed "
+                            f"before it")
+                return
+            w = self._writes.get(value)
+            if w is None or w[0] != key:
+                raise InvariantViolation(
+                    f"{mode} read({key!r}) returned a value never "
+                    f"written to that key: {value!r}")
+            _, w_inv, w_resp = w
+            if w_inv > resp:
+                raise InvariantViolation(
+                    f"{mode} read({key!r}) returned {value!r} invoked "
+                    f"after the read's response")
+            if w_resp is not None:
+                for (i2, r2) in completed:
+                    if r2 <= inv and w_resp <= i2:
+                        raise InvariantViolation(
+                            f"{mode} read({key!r}) returned STALE "
+                            f"value {value!r}: a later write "
+                            f"completed before the read began")

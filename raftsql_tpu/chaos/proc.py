@@ -55,7 +55,9 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from raftsql_tpu.api.client import RaftSQLClient, SQLError, Unavailable
-from raftsql_tpu.chaos.invariants import ElectionSafety, InvariantViolation
+from raftsql_tpu.chaos.invariants import (ElectionSafety,
+                                          InvariantViolation,
+                                          RegisterLinearizability)
 from raftsql_tpu.chaos.schedule import LEADER_TARGET, ProcChaosPlan
 from raftsql_tpu.storage.fsio import EXIT_CODE_FSYNC_CRASH
 
@@ -590,7 +592,7 @@ class ProcChaosRunner:
                           separators=(",", ":")).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 
-    def run(self) -> dict:
+    def _run_impl(self) -> dict:
         wt = threading.Thread(target=self._workload, daemon=True,
                               name="proc-chaos-workload")
         try:
@@ -620,3 +622,261 @@ class ProcChaosRunner:
         return {"schedule_digest": self.plan.digest(),
                 "result_digest": self._verdict_digest(),
                 "seed": self.plan.seed, **self.report}
+
+    def run(self) -> dict:
+        return self._run_impl()
+
+
+class ProcReadChaosRunner(ProcChaosRunner):
+    """The process-plane read nemesis (`make chaos-reads`): the same
+    seeded nemesis script (SIGKILLs, SIGSTOP stalls, restart storms,
+    env disk faults) over real server processes, with the write
+    workload replaced by a KV register workload that races every HTTP
+    read mode against it through the hardened client:
+
+      * linear GETs (X-Consistency: linear — lease or ReadIndex
+        serves them engine-side, 421 redirects chased) checked by the
+        thread-safe real-time register-linearizability invariant;
+      * session GETs at RANDOM nodes presenting the X-Raft-Session
+        watermark the last acked write returned — the answer must be
+        at least as fresh as that write (read-your-writes across
+        failover);
+      * follower GETs (X-Consistency: follower) at random nodes —
+        freshness floor = that replica's commit watermark, checked for
+        monotonicity per key via the session rule.
+
+    One sequential workload thread keeps the real-time order trivially
+    sound (an op completes before the next is invoked).  Verdict
+    digest extends the base families with the read families; counts
+    stay wall-clock-scheduled, so the digest carries booleans."""
+
+    KEYS = 4
+
+    def __init__(self, plan: ProcChaosPlan, workdir: str,
+                 http_engine: str = "aio"):
+        super().__init__(plan, workdir, http_engine=http_engine)
+        self.lin = RegisterLinearizability()
+        # key -> (last acked value seq, its session watermark).
+        self._sess: Dict[str, tuple] = {}
+        self.report.update({"linear_reads": 0, "session_reads": 0,
+                            "follower_reads": 0, "stale_session": 0})
+
+    def _boot(self) -> None:
+        super()._boot()
+        create_deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                self.client.put(
+                    "CREATE TABLE IF NOT EXISTS kv "
+                    "(k text PRIMARY KEY, v text)", deadline_s=15.0)
+                return
+            except (SQLError, Unavailable):
+                if time.monotonic() > create_deadline:
+                    raise
+                time.sleep(0.5)
+
+    def _workload(self) -> None:
+        """Sequential PUT/linear-GET/session-GET/follower-GET cycle:
+        unique values per key (the register checker's contract), one
+        retry token per logical write so crash-retries stay
+        exactly-once."""
+        import random
+        rng = random.Random(self.plan.seed ^ 0x4EAD)
+        n = 0
+        while not self._stop_workload.is_set():
+            try:
+                key = f"k{rng.randrange(self.KEYS)}"
+                val = f"w{n}"
+                n += 1
+                self.lin.begin_write(key, val)
+                try:
+                    wm = self.client.put(
+                        "INSERT INTO kv (k, v) VALUES "
+                        f"('{key}', '{val}') ON CONFLICT(k) "
+                        f"DO UPDATE SET v='{val}'", deadline_s=8.0)
+                except (SQLError, Unavailable):
+                    pass      # unacked: may still commit later (legal)
+                else:
+                    self.lin.end_write(val)
+                    with self._acked_lock:
+                        self.acked.append(val)
+                    self._sess[key] = (n - 1, wm or 0)
+                self._read_cycle(rng)
+            except BaseException as e:   # noqa: BLE001 - surfaced by
+                self._workload_err = e   # _script (incl. violations)
+                return
+            time.sleep(0.05)
+
+    def _read_cycle(self, rng) -> None:
+        sel = "SELECT v FROM kv WHERE k='{}'"
+        # Linear read: full register linearizability, any entry node
+        # (421s chase the leader hint inside the client).
+        key = f"k{rng.randrange(self.KEYS)}"
+        h = self.lin.begin_read(key, mode="linear")
+        try:
+            rows = self.client.get(sel.format(key), linear=True,
+                                   deadline_s=8.0)
+        except (SQLError, Unavailable):
+            pass              # no answer: read never happened
+        else:
+            self.lin.end_read(h, rows.strip().strip("|"))
+            self.report["linear_reads"] += 1
+        # Session read: the last acked write's watermark must be
+        # visible from ANY node.
+        if self._sess:
+            key = rng.choice(sorted(self._sess))
+            seq, wm = self._sess[key]
+            node = rng.randrange(self.plan.peers)
+            try:
+                rows = self.client.get(sel.format(key), node=node,
+                                       consistency="session",
+                                       session=wm, deadline_s=8.0)
+            except (SQLError, Unavailable):
+                pass
+            else:
+                self.report["session_reads"] += 1
+                got = rows.strip().strip("|")
+                if not got or (got.startswith("w")
+                               and got[1:].isdigit()
+                               and int(got[1:]) < seq):
+                    self.report["stale_session"] += 1
+                    raise InvariantViolation(
+                        f"session read({key!r}, wm={wm}) at node "
+                        f"{node} returned {got!r}, older than acked "
+                        f"write w{seq}")
+        # Follower read: replica-commit freshness, any node.
+        key = f"k{rng.randrange(self.KEYS)}"
+        node = rng.randrange(self.plan.peers)
+        try:
+            self.client.get(sel.format(key), node=node,
+                            consistency="follower", deadline_s=8.0)
+        except (SQLError, Unavailable):
+            pass
+        else:
+            self.report["follower_reads"] += 1
+
+    def _converge(self, deadline_s: float = 60.0) -> List[str]:
+        """Every node must answer the full ordered KV table
+        identically, with each key at least as fresh as its last ACKED
+        write (an unacked trailing write may legally have landed too —
+        upserts overwrite, so exact-set equality is the wrong ask)."""
+        want = {k: seq for k, (seq, _wm) in self._sess.items()}
+        query = "SELECT k, v FROM kv ORDER BY k"
+        deadline = time.monotonic() + deadline_s
+        last: object = None
+        while time.monotonic() < deadline:
+            answers = []
+            try:
+                for i in range(self.plan.peers):
+                    answers.append(self.client.get(
+                        query, node=i, deadline_s=10.0))
+            except (Unavailable, SQLError) as e:
+                last = e
+                time.sleep(0.5)
+                continue
+            if all(a == answers[0] for a in answers):
+                rows = {}
+                for line in answers[0].splitlines():
+                    parts = line.strip("|").split("|")
+                    if len(parts) == 2:
+                        rows[parts[0]] = parts[1]
+                stale = {
+                    k: (rows.get(k), s) for k, s in want.items()
+                    if not (rows.get(k, "").startswith("w")
+                            and rows[k][1:].isdigit()
+                            and int(rows[k][1:]) >= s)}
+                if not stale:
+                    return answers[0].splitlines()
+                last = ("stale", stale)
+            else:
+                last = [len(a.splitlines()) for a in answers]
+            time.sleep(0.5)
+        raise InvariantViolation(
+            f"KV convergence failed before the deadline; last={last!r}")
+
+    def _post_mortem(self) -> None:
+        """Durability from DISK alone, upsert-aware: replay every
+        node's WAL, fold the committed (post-dedup) upserts per key in
+        order, and require (a) every node folds to the SAME final KV,
+        (b) each key at least as fresh as its last acked write, and
+        (c) each node's cold-opened SQLite kv table matches its own
+        fold."""
+        import re
+        from raftsql_tpu.runtime.envelope import unwrap
+        from raftsql_tpu.storage.wal import WAL
+        pat = re.compile(r"VALUES \('(k\d+)', '(w\d+)'\)")
+        want = {k: seq for k, (seq, _wm) in self._sess.items()}
+        folds = []
+        for i in range(self.plan.peers):
+            groups = WAL.replay(self.cluster.data_dir(i))
+            gl = groups.get(0)
+            if gl is None:
+                raise InvariantViolation(
+                    f"node {i + 1}: WAL replay has no group 0")
+            committed = gl.entries[:max(0, gl.hard.commit - gl.start)]
+            seen_pids: Set[int] = set()
+            kv: Dict[str, str] = {}
+            for (_term, data) in committed:
+                if not data:
+                    continue
+                pid, payload = unwrap(data)
+                if pid is not None:
+                    if pid in seen_pids:
+                        continue
+                    seen_pids.add(pid)
+                m = pat.search(payload.decode("utf-8", "replace"))
+                if m:
+                    kv[m.group(1)] = m.group(2)
+            folds.append(kv)
+            for k, s in want.items():
+                got = kv.get(k, "")
+                if not (got.startswith("w") and got[1:].isdigit()
+                        and int(got[1:]) >= s):
+                    raise InvariantViolation(
+                        f"node {i + 1}: key {k} folded to {got!r} in "
+                        f"the committed WAL prefix — staler than "
+                        f"acked w{s}")
+            conn = sqlite3.connect(self.cluster.db_path(i))
+            try:
+                rows = dict(conn.execute("SELECT k, v FROM kv"))
+            finally:
+                conn.close()
+            if rows != kv:
+                raise InvariantViolation(
+                    f"node {i + 1}: SQLite kv {rows!r} diverges from "
+                    f"its committed WAL fold {kv!r}")
+        if any(f != folds[0] for f in folds[1:]):
+            raise InvariantViolation(
+                f"nodes folded to different committed KV states: "
+                f"{folds!r}")
+
+    def _verdict_digest(self) -> str:
+        """What must reproduce for the READ nemesis: the schedule, the
+        invariant verdicts, and the read families.  The base runner's
+        storage-fault booleans are deliberately excluded — their op
+        thresholds accumulate with the wall-clock-paced workload, and
+        whether they fire inside the window is kernel-scheduled (the
+        signal nemesis families are guaranteed by the script's
+        deferral loop and asserted by the gate instead)."""
+        import hashlib as _h
+        import json as _j
+        r = self.report
+        doc = {
+            "schedule": self.plan.digest(),
+            "invariants": dict(self.verdicts),
+            "read_families": {
+                "linear": r["linear_reads"] > 0,
+                "session": r["session_reads"] > 0,
+                "follower": r["follower_reads"] > 0,
+                "stale_session": r["stale_session"] == 0,
+                "unexpected_exits": r["unexpected_exits"] == 0,
+            },
+        }
+        blob = _j.dumps(doc, sort_keys=True,
+                        separators=(",", ":")).encode()
+        return _h.sha256(blob).hexdigest()[:16]
+
+    def run(self) -> dict:
+        out = self._run_impl()
+        out["result_digest"] = self._verdict_digest()
+        return out

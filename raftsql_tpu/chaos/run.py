@@ -71,6 +71,12 @@ def _run_mesh(sched) -> dict:
         return MeshChaosRunner(sched, d).run()
 
 
+def _run_reads(plan) -> dict:
+    from raftsql_tpu.chaos.scenarios import ReadNemesisRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return ReadNemesisRunner(plan, d).run()
+
+
 def _check(ok: bool, msg: str) -> bool:
     if not ok:
         print(f"CHAOS FAIL: {msg}", file=sys.stderr)
@@ -127,6 +133,12 @@ def _family_specs():
                            S.generate_tcp_rebind_plan(seed)),
                        False, lambda r: r["rebinds"] == 2
                        and r["commits"] > 20),
+        "reads": (lambda seed: _run_reads(S.generate_reads(seed)),
+                  True, lambda r: r["lease_reads"] > 0
+                  and r["session_reads"] > 0
+                  and r["follower_reads"] > 0
+                  and r["reads_by_mode"].get("linear", 0) > 0
+                  and r["skew_ticks"] > 0 and r["crashes"] >= 1),
     }
 
 
@@ -174,6 +186,105 @@ def run_procs(seed: int, ticks: int, runs: int = 2) -> int:
     return 0 if ok else 1
 
 
+def run_reads(seed: int, runs: int = 2,
+              with_procs: bool = True) -> int:
+    """`make chaos-reads`: the full read-plane gauntlet.
+
+    1. The fused read nemesis (family `reads`), run twice — schedule +
+       result digests must reproduce, every read mode must fire, and
+       the read-linearizability / session invariants must hold.
+    2. The FALSIFICATION pair (schedule.py falsification_plan): the
+       deliberately mis-sized lease bound under 4x skew MUST be caught
+       by the register invariant as a stale lease read, and the SAME
+       schedule with a correctly sized bound must pass — proving the
+       harness detects exactly the bound, not chaos in general.
+    3. The process-plane read nemesis (chaos/proc.py
+       ProcReadChaosRunner): linear/session/follower HTTP reads race
+       the nemesis over real server processes; verdict digests must
+       reproduce.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    reports = []
+    for run in range(runs):
+        r = _run_reads(S.generate_reads(seed))
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+    fired = _family_specs()["reads"][2]
+    for r in reports:
+        ok &= _check(fired(r),
+                     f"reads: a read family never fired ({r})")
+    digests = {(r["schedule_digest"], r["result_digest"])
+               for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"reads: non-reproducible: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_reads(S.falsification_plan(seed, broken=True))
+            except InvariantViolation as e:
+                caught = "STALE" in str(e) or "stale" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the BROKEN lease bound was "
+                         "NOT caught by the read invariant")
+    try:
+        r = _run_reads(S.falsification_plan(seed, broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the CORRECT bound "
+                           f"tripped the invariant: {e}")
+    else:
+        ok &= _check(r["lease_reads"] > 0,
+                     "falsification control: no lease reads granted")
+        print(json.dumps({"falsification_control": "passed",
+                          "lease_reads": r["lease_reads"]}))
+
+    if with_procs:
+        from raftsql_tpu.chaos.proc import ProcReadChaosRunner
+        plan = S.generate_procs(seed, ticks=60)
+        preports = []
+        for run in range(runs):
+            with tempfile.TemporaryDirectory(
+                    prefix="raftsql-reads-procs-") as d:
+                r = ProcReadChaosRunner(plan, d).run()
+            r["run"] = run
+            preports.append(r)
+            print(json.dumps(r, sort_keys=True))
+        for r in preports:
+            ok &= _check(r["linear_reads"] > 0
+                         and r["session_reads"] > 0
+                         and r["follower_reads"] > 0,
+                         f"reads-procs: a read family never fired "
+                         f"({r})")
+            ok &= _check(r["unexpected_exits"] == 0,
+                         f"reads-procs: unscripted server death ({r})")
+        pdig = {(r["schedule_digest"], r["result_digest"])
+                for r in preports}
+        ok &= _check(len(pdig) == 1,
+                     f"reads-procs: non-reproducible verdicts: {pdig}")
+    if ok:
+        print(f"chaos reads ok: seed={seed} "
+              f"schedule={reports[0]['schedule_digest']} "
+              f"result={reports[0]['result_digest']} "
+              f"falsification=caught")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -216,12 +327,23 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", action="store_true",
                     help="process-plane nemesis over real server "
                          "processes (make chaos-procs)")
+    ap.add_argument("--reads", action="store_true",
+                    help="read-plane nemesis (make chaos-reads): the "
+                         "fused lease/ReadIndex/session/follower "
+                         "nemesis run twice + the lease-falsification "
+                         "sensitivity pair + the process-plane read "
+                         "nemesis")
+    ap.add_argument("--no-procs", action="store_true",
+                    help="with --reads: skip the process-plane leg")
     ap.add_argument("--proc-ticks", type=int,
                     default=int(os.environ.get("PROC_TICKS", "80")),
                     help="host ticks for the --procs script phase")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.reads:
+        return run_reads(args.seed, runs=args.runs,
+                         with_procs=not args.no_procs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

@@ -177,6 +177,10 @@ class FusedChaosRunner:
 
     KEYS = 8
     LOG_MATCH_EVERY = 16
+    # Which peers' commit queues the engine materializes (peer 0 is the
+    # client apply plane).  ReadNemesisRunner sets None (= all): its
+    # per-peer read serving state needs every peer's stream.
+    PUBLISH_PEERS: Optional[set] = {0}
 
     def __init__(self, schedule: ChaosSchedule, data_dir: str,
                  cfg: Optional[RaftConfig] = None, steps: int = 1):
@@ -227,7 +231,7 @@ class FusedChaosRunner:
         node = self._make_node()
         if self.steps > 1:
             node._steps = self.steps
-        node.publish_peers = {0}
+        node.publish_peers = self.PUBLISH_PEERS
         # Flight recorder feed (raftsql_tpu/obs/): device event ring +
         # host spans, dumped next to the seed on invariant failure.
         # Tracing never touches consensus state, so the run's schedule
@@ -236,13 +240,14 @@ class FusedChaosRunner:
         replayed: Dict[Tuple[int, int], bytes] = {}
         order: List[Tuple[int, int, bytes]] = []
         for p in range(self.cfg.num_peers):
-            for (g, base, datas) in _drain_fused_q(node.commit_q(p)):
-                if p != 0:
-                    continue             # peer 0's stream is the client
-                for off, d in enumerate(datas):
-                    if d:
-                        replayed[(g, base + 1 + off)] = d
-                        order.append((g, base + 1 + off, d))
+            batches = _drain_fused_q(node.commit_q(p))
+            if p == 0:                   # peer 0's stream is the client
+                for (g, base, datas) in batches:
+                    for off, d in enumerate(datas):
+                        if d:
+                            replayed[(g, base + 1 + off)] = d
+                            order.append((g, base + 1 + off, d))
+            self._boot_peer_drained(p, batches)
         # Compaction floors: the replay legitimately starts above them
         # (compact() only ever drops published entries — the publish
         # cursor gates the floor).
@@ -268,6 +273,11 @@ class FusedChaosRunner:
         self._applied = node._applied[0].copy()
         node.metrics.faults_crashes = self.report["crashes"]
         return node
+
+    def _boot_peer_drained(self, p: int, batches) -> None:
+        """Subclass seam: peer p's replay stream was just drained at
+        (re)boot — ReadNemesisRunner rebuilds its per-peer read state
+        here."""
 
     def _crash_restart(self, tick: int, power_loss: bool = False,
                        tear_peer: int = -1) -> None:
@@ -310,6 +320,17 @@ class FusedChaosRunner:
                 target, _ = got
                 self._pending_reads.append(
                     (f"k{k}", g, target, self.lin.begin_read(f"k{k}")))
+
+    def _drain_tick(self) -> None:
+        """Consume the client (peer 0) commit stream after a tick —
+        ReadNemesisRunner overrides to drain every peer into its
+        per-peer read state too."""
+        for (g, base, datas) in _drain_fused_q(self.node.commit_q(0)):
+            for off, d in enumerate(datas):
+                if d:
+                    self._apply(g, base + 1 + off, d)
+        self._applied = np.maximum(self._applied,
+                                   self.node._applied[0])
 
     def _resolve_reads(self) -> None:
         still = []
@@ -459,13 +480,7 @@ class FusedChaosRunner:
                         self._crash_restart(t, power_loss=True,
                                             tear_peer=int(e.tag))
                         continue
-                    for (g, base, datas) in _drain_fused_q(
-                            self.node.commit_q(0)):
-                        for off, d in enumerate(datas):
-                            if d:
-                                self._apply(g, base + 1 + off, d)
-                    self._applied = np.maximum(self._applied,
-                                               self.node._applied[0])
+                    self._drain_tick()
                     self._resolve_reads()
                     self._observe(t)
                     if self.sched.compact_every and t \
@@ -571,6 +586,200 @@ class MeshChaosRunner(FusedChaosRunner):
         from raftsql_tpu.runtime.mesh import MeshClusterNode
         return MeshClusterNode(self.cfg, self.data_dir, self.mesh,
                                seed=self.sched.seed)
+
+
+class ReadNemesisRunner(FusedChaosRunner):
+    """The read-linearizability nemesis (fused plane): every read mode
+    of the lease read plane — lease, ReadIndex, session, follower —
+    races the write stream while clock skew, leader-targeted
+    partitions, asymmetric cuts, and crashes land.
+
+    Serving model (what a real multi-process deployment would do,
+    simulated honestly): every peer's commit stream is drained into a
+    PER-PEER KV (`publish_peers = None`), and a read served "at peer
+    p" resolves against peer p's applied state — NOT the global truth.
+    A partitioned stale leader therefore really can serve an old
+    value, and only the lease bound stands between that and a
+    linearizability violation:
+
+      * LEASE reads are issued at EVERY peer whose device lease
+        (core/step.py Phase 8b) currently covers now + max_clock_skew
+        — including a deposed leader that does not know it yet.  Under
+        a correctly sized bound (lease_ticks + max_clock_skew <=
+        election_ticks / max_skew_rate) the real-time register
+        invariant must never fire; the falsification plan
+        (schedule.py falsification_plan) oversizes the lease under 4x
+        skew and the invariant MUST fire — proving the harness detects
+        a broken bound, not just chaos.
+      * READINDEX reads ride the base runner's read_index workload.
+      * SESSION reads present the watermark of the client's last
+        completed write and resolve at a RANDOM peer once its apply
+        passes the watermark — checked by SessionConsistency
+        (read-your-writes), which unlike the register rule permits
+        legally-stale-but-watermark-fresh answers.
+      * FOLLOWER reads use the serving peer's own commit index as the
+        watermark (the replicated read-index watermark).
+
+    Fully deterministic: same seeded draws as the base runner, digest
+    compared across runs by `make chaos-reads`.
+    """
+
+    PUBLISH_PEERS: Optional[set] = None       # drain every peer
+
+    def __init__(self, plan, data_dir: str):
+        from raftsql_tpu.chaos.invariants import SessionConsistency
+        from raftsql_tpu.chaos.schedule import ChaosSchedule as _CS
+        sched = _CS(seed=plan.seed, ticks=plan.ticks,
+                    partitions=plan.partitions,
+                    asym_partitions=plan.asym_partitions,
+                    skews=plan.skews, crashes=plan.crashes,
+                    prop_rate=plan.prop_rate,
+                    read_rate=plan.read_index_rate)
+        cfg = RaftConfig(num_groups=plan.groups, num_peers=plan.peers,
+                         log_window=64, max_entries_per_msg=4,
+                         election_ticks=plan.election_ticks,
+                         heartbeat_ticks=1, tick_interval_s=0.0,
+                         lease_ticks=plan.lease_ticks,
+                         max_clock_skew=plan.max_clock_skew)
+        super().__init__(sched, data_dir, cfg=cfg)
+        self.plan = plan
+        P, G = plan.peers, plan.groups
+        self._pkv: List[Dict[str, str]] = [dict() for _ in range(P)]
+        self._papplied = np.zeros((P, G), np.int64)
+        self.session = SessionConsistency()
+        # (peer, key, group, target_commit, register handle)
+        self._pending_lease: List[tuple] = []
+        # (peer, key, group, watermark, mode)
+        self._pending_session: List[tuple] = []
+        # key -> (group, watermark) of its last COMPLETED write — the
+        # session a client would carry (X-Raft-Session).
+        self._last_wm: Dict[str, Tuple[int, int]] = {}
+        self.report.update({
+            "lease_reads": 0, "session_reads": 0, "follower_reads": 0,
+            "lease_peers_leased": 0,
+        })
+
+    # -- per-peer apply plane -------------------------------------------
+
+    def _note_peer_apply(self, p: int, g: int, idx: int,
+                         payload: bytes) -> None:
+        parts = payload.decode("utf-8").split(" ")
+        if len(parts) == 3 and parts[0] == "SET":
+            self._pkv[p][parts[1]] = parts[2]
+            # Committed-history feed for the session checker (first
+            # peer to surface an index wins; log matching keeps every
+            # later copy identical).
+            self.session.note_commit(g, idx, parts[1], parts[2])
+
+    def _boot_peer_drained(self, p: int, batches) -> None:
+        self._pkv[p] = {}
+        for (g, base, datas) in batches:
+            for off, d in enumerate(datas):
+                if d:
+                    self._note_peer_apply(p, g, base + 1 + off, d)
+
+    def _boot(self, first: bool):
+        # In-flight per-peer reads die with the process, like the base
+        # runner's pending ReadIndex reads.
+        self._pending_lease.clear()
+        self._pending_session.clear()
+        node = super()._boot(first)
+        self._papplied = node._applied.copy()
+        return node
+
+    def _drain_tick(self) -> None:
+        node = self.node
+        for p in range(self.cfg.num_peers):
+            for (g, base, datas) in _drain_fused_q(node.commit_q(p)):
+                for off, d in enumerate(datas):
+                    if not d:
+                        continue
+                    idx = base + 1 + off
+                    if p == 0:
+                        self._apply(g, idx, d)
+                    self._note_peer_apply(p, g, idx, d)
+        self._applied = np.maximum(self._applied, node._applied[0])
+        self._papplied = np.maximum(self._papplied, node._applied)
+
+    def _apply(self, g: int, idx: int, payload: bytes) -> None:
+        super()._apply(g, idx, payload)
+        parts = payload.decode("utf-8").split(" ")
+        if len(parts) == 3 and parts[0] == "SET":
+            # The write just COMPLETED (client apply = ack): its
+            # watermark is what a session client would carry forward.
+            self._last_wm[parts[1]] = (g, idx)
+
+    # -- workload --------------------------------------------------------
+
+    def _issue(self, rng: np.random.Generator) -> None:
+        super()._issue(rng)          # writes + ReadIndex reads
+        plan = self.plan
+        cfg = self.cfg
+        P = cfg.num_peers
+        node = self.node
+        if rng.random() < plan.lease_read_rate:
+            k = int(rng.integers(0, self.KEYS))
+            g = k % cfg.num_groups
+            key = f"k{k}"
+            lc = node._lease_col
+            if lc is not None and cfg.lease_ticks > 0:
+                now = node._device_steps
+                leased = [p for p in range(P)
+                          if int(lc[p, g]) > 0
+                          and now + cfg.max_clock_skew < int(lc[p, g])]
+                self.report["lease_peers_leased"] += len(leased)
+                for p in leased:
+                    # The lease read a real deployment would serve AT
+                    # PEER p: target = p's commit, answer = p's state.
+                    target = int(node._hard[p, g, 2])
+                    self.report["lease_reads"] += 1
+                    self._pending_lease.append(
+                        (p, key, g, target,
+                         self.lin.begin_read(key, mode="lease")))
+        if rng.random() < plan.session_read_rate and self._last_wm:
+            keys = sorted(self._last_wm)
+            key = keys[int(rng.integers(0, len(keys)))]
+            g, wm = self._last_wm[key]
+            p = int(rng.integers(0, P))
+            self.report["session_reads"] += 1
+            self._pending_session.append((p, key, g, wm, "session"))
+        if rng.random() < plan.follower_read_rate:
+            k = int(rng.integers(0, self.KEYS))
+            g = k % cfg.num_groups
+            p = int(rng.integers(0, P))
+            # Replicated read-index watermark: the serving peer's own
+            # commit index at request arrival.
+            wm = int(node._hard[p, g, 2])
+            self.report["follower_reads"] += 1
+            self._pending_session.append((p, f"k{k}", g, wm,
+                                          "follower"))
+
+    def _resolve_reads(self) -> None:
+        super()._resolve_reads()     # base ReadIndex reads
+        still: List[tuple] = []
+        for (p, key, g, target, handle) in self._pending_lease:
+            if self._papplied[p][g] >= target:
+                self.lin.end_read(handle, self._pkv[p].get(key, ""))
+            else:
+                still.append((p, key, g, target, handle))
+        self._pending_lease = still
+        still = []
+        for (p, key, g, wm, mode) in self._pending_session:
+            if self._papplied[p][g] >= wm:
+                self.session.check_read(g, key, wm,
+                                        self._pkv[p].get(key, ""),
+                                        mode=mode)
+            else:
+                still.append((p, key, g, wm, mode))
+        self._pending_session = still
+
+    def _report(self) -> dict:
+        r = super()._report()
+        r["plan_digest"] = self.plan.digest()
+        r["session_reads_checked"] = self.session.reads_checked
+        r["reads_by_mode"] = dict(sorted(
+            self.lin.reads_by_mode.items()))
+        return r
 
 
 def schedule_peers(schedule: ChaosSchedule) -> int:

@@ -380,6 +380,126 @@ class ProcChaosPlan:
         return hashlib.sha256(blob).hexdigest()[:16]
 
 
+@dataclasses.dataclass(frozen=True)
+class ReadNemesisPlan:
+    """Scripted read-plane attack (fused plane, chaos/scenarios.py
+    ReadNemesisRunner): writes race lease / ReadIndex / session /
+    follower reads while clock skew, partitions, leader kills and
+    crashes land — checked by the real-time read-linearizability and
+    session-consistency invariants.
+
+    A SEPARATE plan class on purpose: extending ChaosSchedule would
+    change the asdict() digest of every existing family.  The runner
+    projects the fault fields into a ChaosSchedule internally so fault
+    application shares the proven code paths.
+
+    `lease_ticks`/`max_clock_skew` configure the engine's lease bound;
+    `max_skew_rate` caps the per-peer timer rates the skew windows
+    draw.  The SAFE sizing contract (config.py lease_ticks) is
+    lease_ticks + max_clock_skew <= election_ticks / max_skew_rate;
+    `broken_lease=True` deliberately violates it (the falsification
+    plan — the invariant must then CATCH a stale lease read)."""
+    seed: int
+    ticks: int
+    peers: int = 3
+    groups: int = 4
+    election_ticks: int = 16
+    lease_ticks: int = 6
+    max_clock_skew: int = 1
+    max_skew_rate: int = 2
+    broken_lease: bool = False
+    skews: Tuple[SkewWindow, ...] = ()
+    partitions: Tuple[PartitionWindow, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    prop_rate: float = 0.8
+    lease_read_rate: float = 0.8
+    read_index_rate: float = 0.5
+    session_read_rate: float = 0.5
+    follower_read_rate: float = 0.5
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.describe(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def generate_reads(seed: int, ticks: int = 240,
+                   peers: int = 3) -> ReadNemesisPlan:
+    """The read-linearizability nemesis family: two skew windows at
+    rates within the configured bound, a leader-targeted full
+    partition, a one-directional leader cut, and a crash — all while
+    every read mode races the write stream.  Lease bound sized SAFELY
+    (election 16, rate cap 2, lease 6 + skew 1 < 16/2): under this
+    schedule a lease read must NEVER be stale, and the run asserts the
+    invariant checked every family."""
+    rng = np.random.default_rng(seed ^ 0x4EAD)
+    warmup = 40
+    rate = 2
+
+    def draw_incs() -> Tuple[int, ...]:
+        incs = [1] * peers
+        fast = int(rng.integers(0, peers))
+        incs[fast] = rate
+        if rng.random() < 0.5:
+            incs[int((fast + 1) % peers)] = 0    # a stalled clock too
+        return tuple(incs)
+
+    s0 = int(rng.integers(warmup, warmup + ticks // 4))
+    w0 = SkewWindow(s0, s0 + int(rng.integers(25, 40)), draw_incs())
+    s1 = int(rng.integers(ticks // 2, int(ticks * 0.75)))
+    w1 = SkewWindow(s1, s1 + int(rng.integers(25, 40)), draw_incs())
+    p0 = int(rng.integers(warmup, ticks // 3))
+    part = PartitionWindow(p0, p0 + int(rng.integers(25, 40)),
+                           LEADER_TARGET)
+    a0 = int(rng.integers(ticks // 3, int(ticks * 0.7)))
+    asym = AsymPartitionWindow(a0, a0 + int(rng.integers(20, 35)),
+                               LEADER_TARGET,
+                               int(rng.integers(0, peers)))
+    crash = CrashEvent(int(rng.integers(int(ticks * 0.55),
+                                        int(ticks * 0.85))))
+    return ReadNemesisPlan(seed=seed, ticks=ticks, peers=peers,
+                           election_ticks=16, lease_ticks=6,
+                           max_clock_skew=1, max_skew_rate=rate,
+                           skews=(w0, w1), partitions=(part,),
+                           asym_partitions=(asym,), crashes=(crash,))
+
+
+def falsification_plan(seed: int = 0,
+                       broken: bool = True) -> ReadNemesisPlan:
+    """DIRECTED lease-falsification scenario: both followers run their
+    clocks at 4x through a long leader partition, so a new leader is
+    elected (election_ticks/4 of ITS clock) while the old one still
+    sits inside a mis-sized lease.  broken=True sizes the lease at
+    election_ticks (legal only for rate <= ~1) — the stale window is
+    real and the read-linearizability invariant MUST fire.
+    broken=False sizes it to the actual rate (16/4 - margin) — the
+    same schedule must pass, which proves the harness is sensitive to
+    exactly the bound and not just to chaos in general."""
+    # All clocks at 4x (the lease is measured in device steps, so the
+    # leader's own rate is irrelevant — what matters is how fast the
+    # FOLLOWERS' election timers run); the partition resolves to
+    # whoever leads group 0 when it opens.
+    skew = SkewWindow(40, 160, (4, 4, 4))
+    part = PartitionWindow(50, 160, LEADER_TARGET)
+    return ReadNemesisPlan(
+        seed=seed, ticks=200, peers=3, groups=2,
+        election_ticks=16,
+        # Broken: the lease outlives the whole election dance the 4x
+        # clocks run behind the partition (sized like an operator who
+        # tuned for no skew at all); correct: within election/rate.
+        lease_ticks=100 if broken else 3,
+        max_clock_skew=0, max_skew_rate=4,
+        broken_lease=broken,
+        skews=(skew,), partitions=(part,),
+        prop_rate=1.0, lease_read_rate=1.0,
+        read_index_rate=0.4, session_read_rate=0.4,
+        follower_read_rate=0.4)
+
+
 def generate_procs(seed: int, ticks: int = 80,
                    peers: int = 3) -> ProcChaosPlan:
     """Derive a process-plane scenario from one seed, with every fault

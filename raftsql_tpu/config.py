@@ -113,6 +113,27 @@ class RaftConfig:
     # this; the 2015 vendored copy predates it.
     prevote: bool = True
 
+    # Leader leases (raft §6.4.1 / thesis §6.3, the read plane): a
+    # leader whose latest heartbeat round was confirmed by a quorum at
+    # (its local) time s may serve LINEARIZABLE reads without a fresh
+    # quorum round while `now + max_clock_skew < s + lease_ticks`.
+    # Soundness rests on the prevote lease check (core/step.py Phase
+    # 2b): every confirmed peer refuses election probes until
+    # `election_ticks` of ITS OWN clock elapse after s, and any new
+    # quorum must intersect the confirmed one — so the deployment must
+    # guarantee  lease_ticks + max_clock_skew <= election_ticks / rho
+    # where rho bounds how much faster any peer's clock can run
+    # relative to the lease holder's (the chaos skew machinery
+    # deliberately violates this to prove the invariant harness would
+    # catch a mis-sized bound).  0 disables leases: linear reads always
+    # pay the ReadIndex quorum round.  Requires prevote.
+    lease_ticks: int = 0
+
+    # Clock-skew slack subtracted from every lease validity check (in
+    # ticks of the lease holder's clock).  Part of the lease bound
+    # above; meaningless while lease_ticks == 0.
+    max_clock_skew: int = 1
+
     # Pipelined-replication window: how many optimistic AppendEntries
     # batches may be in flight beyond a follower's acked match before the
     # leader stalls and re-sends (core/step.py Phase 9).  The analog of
@@ -179,6 +200,15 @@ class RaftConfig:
             raise ValueError(
                 f"commit_rule {self.commit_rule!r} scans the term ring; "
                 "it requires keep_ring=True")
+        if self.lease_ticks < 0:
+            raise ValueError("lease_ticks must be >= 0")
+        if self.max_clock_skew < 0:
+            raise ValueError("max_clock_skew must be >= 0")
+        if self.lease_ticks and not self.prevote:
+            # The lease's exclusion window IS the prevote in-lease
+            # refusal: without it a fast-clocked peer can assemble a
+            # quorum inside the lease and serve stale reads.
+            raise ValueError("lease_ticks > 0 requires prevote=True")
 
     @property
     def quorum(self) -> int:

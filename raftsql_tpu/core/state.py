@@ -81,6 +81,16 @@ class PeerState(NamedTuple):
     voters: jax.Array        # [G, P] bool
     voters_joint: jax.Array  # [G, P] bool
 
+    # Leader-lease evidence (config.lease_ticks, core/step.py lease
+    # phase): device step at which the newest CURRENT-term append
+    # response from each peer was processed while this peer led the
+    # group (0 = none).  Strictly an OUTPUT of consensus — no other
+    # transition reads it, so carrying it (even disabled) can never
+    # perturb a trajectory.  Deliberately volatile: a restart starts
+    # from zeros, so a rebooted leader holds no lease until a fresh
+    # quorum round confirms it.
+    resp_tick: jax.Array     # [G, P] i32
+
     rng: jax.Array           # [2]/key PRNG state for election jitter
     tick: jax.Array          # [] i32 step counter (for PRNG folding)
 
@@ -144,6 +154,13 @@ class StepInfo(NamedTuple):
     app_n: jax.Array         # i32 number of entries written
     app_conflict: jax.Array  # bool append truncated conflicting suffix
     new_log_len: jax.Array   # i32 log length after the step
+    # Leader-lease expiry in device-step units (0 = no lease): while
+    # `host_step_now + cfg.max_clock_skew < lease`, this peer may serve
+    # group g a linearizable read at its current commit index without a
+    # quorum round (core/step.py lease phase; always 0 when
+    # cfg.lease_ticks == 0).  The §6.4 current-term-commit
+    # precondition is already folded in on device.
+    lease: jax.Array         # i32 [G]
     # Leader view [G, P]: where each peer's replication stands.  The host
     # uses this to spot followers that have fallen out of the device term
     # ring (next_idx <= log_len - W) OR below the transition-table floor
@@ -200,6 +217,7 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
         next_idx=jnp.ones((g, p), I32),
         voters=voters,
         voters_joint=voters_joint,
+        resp_tick=jnp.zeros((g, p), I32),
         rng=key,
         tick=jnp.zeros((), I32),
     )
@@ -394,6 +412,7 @@ def install_snapshot_state(state: PeerState, g: jax.Array,
         match=state.match.at[g].set(0),
         next_idx=state.next_idx.at[g].set(last_idx + 1),
         elapsed=state.elapsed.at[g].set(0),
+        resp_tick=state.resp_tick.at[g].set(0),
     )
 
 

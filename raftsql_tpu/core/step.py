@@ -41,8 +41,9 @@ from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
 from raftsql_tpu.ops.quorum import (masked_quorum_commit_index,
+                                    masked_quorum_match_index,
                                     masked_vote_win, quorum_commit_index,
-                                    vote_count)
+                                    quorum_match_index, vote_count)
 
 
 def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
@@ -498,6 +499,51 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
                            | (commit > commit0))
     hb = jnp.where(hb_fire, 0, hb)
 
+    # ---- Phase 8b: leader leases (raft §6.4.1, config.lease_ticks).
+    # Evidence = the newest CURRENT-term append response from each peer
+    # (success or reject — either way the responder processed an append
+    # at our term, which reset its election timer, Phase 8's `reset`):
+    # stamp the device step it was processed at.  A response observed
+    # at step T answers a round the responder processed at T-1, so the
+    # quorum-th largest stamp minus 1 is when a quorum's election
+    # timers were last known reset — any NEW quorum must intersect that
+    # set (quorum intersection), and the prevote lease check (Phase 2b)
+    # keeps every member of it from granting a probe for election_ticks
+    # of its own clock.  The lease never feeds back into consensus:
+    # resp_tick/lease are write-only outputs, so a disabled lease
+    # (lease_ticks == 0, the default) leaves every trajectory
+    # bit-identical with the kernel compiled in.
+    tick_now = state.tick
+    lease_role = role == LEADER          # post-Phase-8 (leaders never fire)
+    resp_tick = jnp.where(bumped[:, None], 0, state.resp_tick)
+    resp_tick = jnp.where(rs, tick_now, resp_tick)
+    resp_tick = jnp.where(become_leader[:, None], 0, resp_tick)
+    # The leader's own slot counts as confirmed NOW; non-leaders carry
+    # no evidence at all (a deposed-and-reelected leader restarts its
+    # lease from scratch).
+    resp_tick = jnp.where(
+        lease_role[:, None],
+        jnp.where(self_onehot, tick_now, resp_tick), 0)
+    if cfg.lease_ticks > 0:
+        if cfg.static_full_voters:
+            q_tick = quorum_match_index(resp_tick, cfg.quorum)
+        else:
+            # Joint consensus: the lease needs a quorum of BOTH masks
+            # (a read served on the old majority alone could miss a
+            # leader elected by the new one, and vice versa).
+            q_tick = jnp.minimum(
+                masked_quorum_match_index(resp_tick, voters),
+                masked_quorum_match_index(resp_tick, jvoters))
+        # §6.4 precondition, folded in on device: the lease read's
+        # target is the leader's commit index, which is only current
+        # once an entry of its own term has committed.
+        cur_ok = (commit >= 1) & (term_of1(commit) == term)
+        lease_until = jnp.where(
+            lease_role & cur_ok & (q_tick > 0),
+            q_tick - 1 + jnp.int32(cfg.lease_ticks), 0)
+    else:
+        lease_until = jnp.zeros((G,), I32)
+
     # ---- Phase 9: compose the outbox.  Write order = priority order:
     # responses first, then candidate vote-request broadcast, then leader
     # append broadcast.  A later write overriding a response is safe: every
@@ -637,6 +683,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         elapsed=elapsed, timeout=timeout, hb_elapsed=hb,
         votes=votes, match=match, next_idx=next_idx,
         voters=voters, voters_joint=jvoters,
+        resp_tick=resp_tick,
         rng=state.rng, tick=state.tick + 1)
 
     # Ticks until any timer could fire with no further input: non-leader
@@ -659,6 +706,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         app_n=jnp.where(accept, a_n, 0),
         app_conflict=conflict,
         new_log_len=log_len,
+        lease=lease_until,
         next_idx=next_idx,
         floor=floor1,
         timer_margin=timer_margin)
@@ -696,7 +744,7 @@ IB_NCOLS = len(MSG_FIELDS)
 INFO_FIELDS = ("commit", "role", "term", "voted_for", "leader_hint",
                "prop_base", "prop_accepted", "noop", "app_from",
                "app_start", "app_n", "app_conflict", "new_log_len",
-               "floor")
+               "floor", "lease")
 INFO_NCOLS = len(INFO_FIELDS)
 
 

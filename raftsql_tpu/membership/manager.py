@@ -200,6 +200,25 @@ class MembershipManager:
             return got >= n // 2 + 1
         return maj(c.voters) and maj(c.joint)
 
+    def quorum_nth(self, group: int, vals: np.ndarray) -> int:
+        """Mask-weighted quorum-th largest of per-peer values under the
+        active config — the lease plane's "latest clock at which a full
+        quorum had confirmed us" (runtime/node.py lease_read; vals[p]
+        already carries the caller's self stamp).  Joint consensus
+        takes the MIN of both masks' quorum values: a lease is only as
+        fresh as the staler majority, exactly like the masked commit
+        rule."""
+        with self._lock:
+            c = self._cfg[group]
+
+        def nth(mask: int) -> int:
+            got = sorted((int(vals[i]) for i in range(self.P)
+                          if mask >> i & 1), reverse=True)
+            if not got:
+                return -(1 << 40)    # all-learner: no quorum, no lease
+            return got[popcount(mask) // 2]
+        return min(nth(c.voters), nth(c.joint))
+
     # -- building changes (admin plane) ---------------------------------
 
     OPS = ("add", "add_learner", "remove_learner", "promote", "remove")

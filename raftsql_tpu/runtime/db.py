@@ -148,6 +148,21 @@ class NotLeaderError(Exception):
         self.leader = leader
 
 
+class ReadTimeout(TimeoutError):
+    """A read could not be served within the request timeout — a TYPED,
+    RETRYABLE condition (quorum unreachable mid-ReadIndex round, apply
+    lagging the read point, a session watermark not yet replicated, or
+    leadership lost mid-round without a forward hint).  Subclasses
+    TimeoutError so both HTTP planes keep answering 503 Service
+    Unavailable (retry-at-will), never a 400; `phase` names which wait
+    ran out, so a client log pinpoints the stall."""
+
+    def __init__(self, group: int, phase: str, detail: str):
+        super().__init__(f"group {group}: {detail}")
+        self.group = group
+        self.phase = phase
+
+
 class AckFuture:
     """The reference's buffered `chan error` (db.go:107): one result,
     delivered once, awaited by one client."""
@@ -486,53 +501,134 @@ class RaftDB:
             if not cbs:
                 del self._q2cb[(group, query)]
 
-    def query(self, query: str, group: int = 0,
-              linear: bool = False, timeout: float = 10.0) -> str:
-        """Local read — never touches consensus (db.go:123-130).
+    def watermark(self, group: int = 0) -> int:
+        """This replica's applied index for `group` — the session
+        watermark echoed as X-Raft-Session on both HTTP planes.  A
+        client that carries the largest watermark it has seen and
+        presents it on `mode="session"` reads gets read-your-writes
+        and monotonic reads from ANY replica."""
+        return int(self._sms[group].applied_index())
 
-        linear=True upgrades to a LINEARIZABLE read (ReadIndex, raft
-        §6.4 — a capability the reference lacks): only the group's
-        current leader serves it, after (a) a quorum re-confirms its
-        leadership on a round started after this call and (b) the local
-        state machine has applied everything committed at call time.
-        Raises NotLeaderError (with the last known leader) elsewhere."""
+    def _wait_applied(self, group: int, target: int, deadline: float,
+                      tick: float, phase: str) -> None:
+        """Block until the local apply reaches `target` (bounded)."""
+        while self._sms[group].applied_index() < target:
+            if self._failed is not None:
+                raise self._failed
+            now = time.monotonic()
+            if now > deadline:
+                raise ReadTimeout(
+                    group, phase,
+                    f"apply (at {self._sms[group].applied_index()}) "
+                    f"did not reach read point {target} in time")
+            time.sleep(min(tick, max(deadline - now, 0.0005)))
+
+    def query(self, query: str, group: int = 0,
+              linear: bool = False, timeout: float = 10.0,
+              mode: Optional[str] = None, watermark: int = 0) -> str:
+        """Read path, five consistency modes (README read-modes table):
+
+          - "local" (default): the reference's stale local read —
+            never touches consensus (db.go:123-130);
+          - "session": local read AFTER the replica's apply reaches the
+            client-provided `watermark` (X-Raft-Session echo from a
+            previous write/read) — read-your-writes + monotonic reads
+            at any replica;
+          - "follower": local read at the replicated read-index
+            watermark — this node's CURRENT commit index — so the
+            answer reflects everything this replica knows committed at
+            request arrival (fresher than local, no leader round);
+          - "linear" (or linear=True): LINEARIZABLE.  Served from the
+            leader LEASE when one covers now + max_clock_skew (no
+            quorum round, config.lease_ticks), degrading to the
+            ReadIndex quorum round (raft §6.4), degrading to
+            NotLeaderError (421 + leader hint) off-leader — each
+            degradation explicit, never a silent stale read.
+
+        Bounded: every wait raises typed, retryable ReadTimeout (503)
+        within `timeout`; leadership lost mid-round surfaces
+        NotLeaderError on the next poll, never an unbounded spin."""
         if not is_select(query):
             raise ValueError("expected SELECT")
         if not 0 <= group < self.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.num_groups})")
-        if linear:
-            node = self.pipe.node
-            tick = node.cfg.tick_interval_s or 0.001
-            deadline = time.monotonic() + timeout
-            while True:
-                got = node.read_index(group)
-                if got is None:
-                    raise NotLeaderError(group, node.leader_of(group) + 1)
-                if got != ():
-                    break
-                # Leader without a committed current-term entry yet
-                # (raft §6.4 precondition) — its no-op is in flight.
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"group {group}: no current-term commit yet")
-                time.sleep(tick)
-            target, reg = got
-            while not node.read_ready(group, reg):
-                if node.read_index(group) is None:
-                    raise NotLeaderError(group, node.leader_of(group) + 1)
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"group {group}: leadership not re-confirmed "
-                        "(no quorum reachable?)")
-                time.sleep(tick)
-            while self._sms[group].applied_index() < target:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"group {group}: apply lagging read index "
-                        f"{target}")
-                time.sleep(tick)
+        if mode is None:
+            mode = "linear" if linear else "local"
+        node = self.pipe.node
+        m = getattr(node, "metrics", None)
+        tick = node.cfg.tick_interval_s or 0.001
+        deadline = time.monotonic() + timeout
+        if mode == "local":
+            if m is not None:
+                m.reads_local += 1
+        elif mode == "session":
+            if m is not None:
+                m.reads_session += 1
+            if watermark > 0:
+                self._wait_applied(group, watermark, deadline, tick,
+                                   "session")
+        elif mode == "follower":
+            if m is not None:
+                m.reads_follower += 1
+            wm_fn = getattr(node, "commit_watermark", None)
+            target = wm_fn(group) if wm_fn is not None \
+                else max(watermark, 0)
+            self._wait_applied(group, target, deadline, tick, "follower")
+        elif mode == "linear":
+            self._linear_wait(node, group, deadline, tick)
+        else:
+            raise ValueError(f"unknown read mode {mode!r}")
         return self._sms[group].query(query)
+
+    def _linear_wait(self, node, group: int, deadline: float,
+                     tick: float) -> None:
+        """The linearizable read protocol: lease fast path, then the
+        ReadIndex round, each wait bounded by `deadline`."""
+        m = getattr(node, "metrics", None)
+        lease_fn = getattr(node, "lease_read", None)
+        lease_on = node.cfg.lease_ticks > 0 and lease_fn is not None
+        if lease_on:
+            target = lease_fn(group)
+            if target is not None:
+                if m is not None:
+                    m.reads_lease += 1
+                self._wait_applied(group, target, deadline, tick,
+                                   "lease_apply")
+                return
+            # Lease unavailable (expired / not leader / precondition
+            # pending): degrade to the full quorum round.
+            if m is not None:
+                m.lease_degrades += 1
+        if m is not None:
+            m.reads_read_index += 1
+        while True:
+            got = node.read_index(group)
+            if got is None:
+                raise NotLeaderError(group, node.leader_of(group) + 1)
+            if got != ():
+                break
+            # Leader without a committed current-term entry yet
+            # (raft §6.4 precondition) — its no-op is in flight.
+            if time.monotonic() > deadline:
+                raise ReadTimeout(group, "read_index",
+                                  "no current-term commit yet")
+            time.sleep(tick)
+        target, reg = got
+        while not node.read_ready(group, reg):
+            # Leadership lost mid-round: surface the typed redirect on
+            # the NEXT poll — the round can never confirm and spinning
+            # it out to the deadline would stall the client for
+            # nothing (the leader hint names where to retry).
+            if node.read_index(group) is None:
+                raise NotLeaderError(group, node.leader_of(group) + 1)
+            if time.monotonic() > deadline:
+                raise ReadTimeout(
+                    group, "confirm",
+                    "leadership not re-confirmed "
+                    "(no quorum reachable?)")
+            time.sleep(tick)
+        self._wait_applied(group, target, deadline, tick, "apply")
 
     def metrics(self) -> dict:
         def ms(v):

@@ -207,6 +207,17 @@ class ClusterHostPlane:
         self._prop_lock = threading.Lock()
         self._hints = np.full(G, -1, np.int64)
         self._tick_no = 0
+        # Leader-lease host cache (config.lease_ticks): the device
+        # lease phase (core/step.py Phase 8b) returns each peer row's
+        # [G] lease-expiry vector in device-STEP units; `_lease_col`
+        # is the last dispatch's [P, G] slice and `_device_steps` the
+        # host's running step count (ticks x steps-per-dispatch), the
+        # "now" the validity check compares against.  Sound here
+        # because the fused/mesh plane steps every peer once per host
+        # step — per-peer skew only scales timer_inc, which is exactly
+        # the rate bound cfg.max_clock_skew/lease_ticks must cover.
+        self._lease_col: Optional[np.ndarray] = None
+        self._device_steps = 0
         # Last tick's packed info, published at the START of the next
         # tick (overlapped with the device dispatch) — its entries are
         # already durable by then.
@@ -739,6 +750,36 @@ class ClusterHostPlane:
 
     # -- linearizable reads (single-controller cluster) -----------------
 
+    def commit_watermark(self, group: int) -> int:
+        """Replicated read-index watermark for follower/session reads
+        (X-Raft-Session): the hinted leader's commit index — in the
+        co-located cluster that IS the global commit point."""
+        p = max(int(self._hints[group]), 0)
+        return int(self._hard[p, group, 2])
+
+    def lease_read(self, group: int) -> Optional[int]:
+        """Serve a linearizable read from the device-computed leader
+        lease: the read's target commit index while the hinted
+        leader's lease covers `now + max_clock_skew`, else None (the
+        caller degrades to read_index — never a silent stale read).
+        The §6.4 current-term-commit precondition is folded into the
+        device lease value (0 while pending)."""
+        cfg = self.cfg
+        if cfg.lease_ticks <= 0:
+            return None
+        lc = self._lease_col
+        p = int(self._hints[group])
+        if lc is None or p < 0:
+            return None
+        until = int(lc[p, group])
+        if until > 0 \
+                and self._device_steps + cfg.max_clock_skew < until:
+            self.metrics.lease_grants += 1
+            return int(self._hard[p, group, 2])
+        if until > 0:
+            self.metrics.lease_expiries += 1
+        return None
+
     def read_index(self, group: int):
         """ReadIndex for the co-located cluster: every peer of the
         group lives in THIS process, so no other process can hold a
@@ -1017,6 +1058,8 @@ class ClusterHostPlane:
                       if pinfo.ndim == 4 else [pinfo])
         pinfo = step_infos[-1]
         self._hints = pinfo[0, :, _C["leader_hint"]]
+        self._lease_col = pinfo[:, :, _C["lease"]]
+        self._device_steps += len(step_infos)
         # Stage the 2a ranges NOW (this pops the device-accepted
         # proposals off the queues): whether the durable phase runs
         # inline below or stashed into the next dispatch window, the

@@ -234,6 +234,21 @@ class RaftNode:
         self._resp_term = np.zeros((G, num_nodes), np.int64)
         self._last_role = np.zeros(G, np.int64)
         self._last_hint = np.full(G, -1, np.int64)
+        # Leader-lease clock (config.lease_ticks): leases must be
+        # measured in TIMER units (what election timeouts are counted
+        # in), not step counts — the event loop runs timer_inc=0 work
+        # steps and elides idle steps with timer_inc=k, so steps and
+        # timer time diverge freely.  _lease_clock advances with every
+        # tick's timer_inc; _round_clock[seq % R] remembers the clock
+        # at which round `seq` (= tick number, the seq stamped on
+        # outgoing append REQs) went out, so a quorum of seq echoes
+        # converts to "a quorum confirmed me at clock c" and the lease
+        # runs to c + lease_ticks.  Rounds older than the ring are
+        # simply unprovable — the check degrades to ReadIndex.
+        self._lease_clock = 0
+        self._ROUND_RING = 4096
+        self._round_seq = np.full(self._ROUND_RING, -1, np.int64)
+        self._round_clock = np.zeros(self._ROUND_RING, np.int64)
         self._dedup = [DedupWindow() for _ in range(G)]
         self._hard_np = np.zeros((G, 3), np.int64)
         self._hard_np[:, 1] = NO_VOTE
@@ -560,6 +575,68 @@ class RaftNode:
     # linearizable reads (ReadIndex, raft §6.4 — beyond the reference's
     # stale-local-read model, db.go:128-130)
 
+    # "No evidence" filler for the lease quorum sort: far below any
+    # reachable lease clock, so a peer with no provable confirmation
+    # can never contribute a lease-extending stamp (0 would alias the
+    # boot-time clock and grant phantom boot leases).
+    _NO_LEASE_CLOCK = -(1 << 40)
+
+    def commit_watermark(self, group: int) -> int:
+        """This node's current commit index for `group` — the
+        replicated read-index watermark follower/session reads wait
+        on (X-Raft-Session).  Host cache only; safe from any thread."""
+        return int(self._hard_np[group, 2])
+
+    def lease_read(self, group: int) -> Optional[int]:
+        """Serve a linearizable read from the leader lease: returns the
+        read's target commit index, or None when no valid lease covers
+        `now + max_clock_skew` (the caller degrades to the ReadIndex
+        round — never a silent stale read).
+
+        The lease: each peer's newest seq echo at our current term
+        names the newest round it confirmed; mapping seqs to the lease
+        clock they departed at and taking the quorum-th largest gives
+        the latest clock c at which a full quorum had confirmed our
+        leadership (and, by the Phase-8 reset + prevote in-lease rule,
+        cannot grant an election probe before c + election_ticks of
+        its own clock).  Requires the §6.4 current-term-commit
+        precondition exactly like read_index."""
+        cfg = self.cfg
+        if cfg.lease_ticks <= 0 or self._last_role[group] != LEADER:
+            return None
+        term = int(self._hard_np[group, 0])
+        commit = int(self._hard_np[group, 2])
+        # try_term_of: client threads race the compactor; degrade, not
+        # assert (same contract as read_index).
+        if commit < 1 \
+                or self.payload_log.try_term_of(group, commit) != term:
+            return None
+        with self._stage_lock:
+            echo = self._resp_echo[group].copy()
+            rterm = self._resp_term[group].copy()
+        R = self._ROUND_RING
+        clocks = np.full(self.num_nodes, self._NO_LEASE_CLOCK, np.int64)
+        now = int(self._lease_clock)
+        for p in range(self.num_nodes):
+            if p == self.self_id:
+                continue
+            s = int(echo[p])
+            if s <= 0 or int(rterm[p]) != term:
+                continue
+            if int(self._round_seq[s % R]) == s:
+                clocks[p] = self._round_clock[s % R]
+        clocks[self.self_id] = now
+        mm = self.membership
+        if mm is not None and not mm.is_default(group):
+            q = mm.quorum_nth(group, clocks)
+        else:
+            q = int(np.sort(clocks)[self.num_nodes - cfg.quorum])
+        if now + cfg.max_clock_skew < q + cfg.lease_ticks:
+            self.metrics.lease_grants += 1
+            return commit
+        self.metrics.lease_expiries += 1
+        return None
+
     def read_index(self, group: int):
         """Register a linearizable read.
 
@@ -868,6 +945,15 @@ class RaftNode:
         cfg = self.cfg
         G, P, E = cfg.num_groups, cfg.num_peers, cfg.max_entries_per_msg
         m = self.metrics
+
+        # Lease round bookkeeping: this tick's outgoing REQs carry
+        # seq = _tick_no; remember the lease clock they depart at
+        # (clock first, seq second — a torn cross-thread read then
+        # fails the seq match and degrades, never inflates a lease).
+        slot = self._tick_no % self._ROUND_RING
+        self._round_clock[slot] = self._lease_clock
+        self._round_seq[slot] = self._tick_no
+        self._lease_clock += timer_inc
 
         # Staging (snapshot installs + inbox build) is timed separately
         # from the device step — a multi-MB install must not read as "the
@@ -1487,10 +1573,18 @@ class RaftNode:
 
         # Proposal forwarding: anything still queued while we are not the
         # leader goes to the leader hint, and is tracked for retry until
-        # its commit is observed (see _fwd above).
+        # its commit is observed (see _fwd above).  Deadlines are in
+        # LEASE-CLOCK (timer) units, not tick numbers: the event-driven
+        # loop elides idle steps, so "4 * election_ticks" tick numbers
+        # could be many times that in wall time — a proposal forwarded
+        # to a leader that died the same instant then sat unreclaimed
+        # for tens of seconds while the client's retries all timed out
+        # (found by the process-plane read nemesis: the while-down PUT
+        # stall).  Timer units track wall time by construction.
         role = info.role
         hint = info.leader_hint
-        deadline = self._tick_no + 4 * cfg.election_ticks
+        clock = self._lease_clock
+        deadline = clock + 4 * cfg.election_ticks
         with self._prop_lock:
             # O(dirty), not O(G): only groups with queued or in-flight
             # forwarded proposals are walked — at G=10k the full-range
@@ -1498,12 +1592,25 @@ class RaftNode:
             # queue empty.
             for g in list(self._fwd_groups):
                 fwd_g = self._fwd[g]
+                if fwd_g and role[g] == LEADER:
+                    # WE became the leader: an in-flight forward
+                    # targeted a PREVIOUS leader and nobody else will
+                    # commit it — reclaim everything immediately (the
+                    # envelope dedup collapses any copy that did land,
+                    # so the requeue is always safe).  Without this,
+                    # a proposal forwarded to a leader that crashed
+                    # before our own election sat in limbo until the
+                    # deadline even though we could accept it NOW.
+                    self._props[g].extendleft(
+                        reversed([p for (p, _) in fwd_g]))
+                    self._prop_len[g] += len(fwd_g)
+                    self._fwd[g] = []
+                    fwd_g = self._fwd[g]
                 if fwd_g:
-                    expired = [p for (p, d) in fwd_g
-                               if d <= self._tick_no]
+                    expired = [p for (p, d) in fwd_g if d <= clock]
                     if expired:
                         self._fwd[g] = [(p, d) for (p, d) in fwd_g
-                                        if d > self._tick_no]
+                                        if d > clock]
                         self._props[g].extendleft(reversed(expired))
                         self._prop_len[g] += len(expired)
                 h = int(hint[g])

@@ -42,11 +42,15 @@ nothing else parses these):
   request:    u8 op | u64 req_id | u32 group | u8 flags | u64 token
               | bytes body
       op 1 PUT      body = sql          (token: X-Raft-Retry-Token, 0 none)
-      op 2 GET      body = sql          (flags bit 0: linearizable)
+      op 2 GET      body = sql          (flags bit 0: linearizable,
+                                         bit 1: session, bit 2: follower;
+                                         token = session watermark)
       op 3 DOC      body = document name (metrics/health/members/...)
       op 4 MEMBER   body = json {group, op, peer}
   completion: u64 req_id | u8 status | u32 leader | bytes body
-      status 0 OK   (body = rows/doc for GET/DOC/MEMBER, empty for PUT)
+      status 0 OK   (body = rows/doc for GET/DOC/MEMBER, empty for PUT;
+                     leader = the engine's session watermark for the
+                     request's group — the X-Raft-Session echo)
       status 1 ERR  (body = message; deterministic 400 class)
       status 2 NOT_LEADER (leader = 1-based hint; 421 class)
       status 3 UNAVAILABLE (body = message; 503 class)
@@ -421,6 +425,14 @@ class RingServer:
 
     # -- request handlers -----------------------------------------------
 
+    def _watermark(self, group: int) -> int:
+        """Engine session watermark for a ST_OK completion's leader
+        field (clamped to the wire's u32; advisory, never fatal)."""
+        try:
+            return min(int(self.rdb.watermark(group)), 0xFFFFFFFF)
+        except Exception:                               # noqa: BLE001
+            return 0
+
     def _handle_put(self, worker: int, req_id: int, group: int,
                     token: int, body: bytes) -> None:
         entry = None
@@ -431,19 +443,19 @@ class RingServer:
                     self._tokens.move_to_end(token)
                     if ent[0]:          # resolved: replay the outcome
                         self.deduped += 1
-                        err_body = ent[1]
+                        err_body, wm = ent[1], ent[3]
                     else:               # in flight: join its waiters
                         ent[2].append((worker, req_id))
                         self.deduped += 1
                         return
                 else:
-                    entry = [False, None, [(worker, req_id)]]
+                    entry = [False, None, [(worker, req_id)], 0]
                     self._tokens[token] = entry
                     while len(self._tokens) > self._tok_cap:
                         self._tokens.popitem(last=False)
             if entry is None:
                 if err_body is None:
-                    self._complete(worker, req_id, ST_OK, 0, b"")
+                    self._complete(worker, req_id, ST_OK, wm, b"")
                 else:
                     self._complete(worker, req_id, ST_ERR, 0, err_body)
                 return
@@ -451,43 +463,52 @@ class RingServer:
             fut = self.rdb.propose(body.decode("utf-8"), group,
                                    token=token or None)
         except Exception as e:                          # noqa: BLE001
-            self._resolve_put(entry, worker, req_id, self._err_body(e))
+            self._resolve_put(entry, worker, req_id, self._err_body(e),
+                              0)
             return
         self.proposed += 1
 
         def _done(err):
-            self._resolve_put(entry, worker, req_id,
-                              None if err is None else
-                              self._err_body(err))
+            self._resolve_put(
+                entry, worker, req_id,
+                None if err is None else self._err_body(err),
+                self._watermark(group) if err is None else 0)
 
         fut.add_done_callback(_done)
 
     def _resolve_put(self, entry, worker: int, req_id: int,
-                     err_body: Optional[bytes]) -> None:
+                     err_body: Optional[bytes], wm: int) -> None:
         """Deliver a PUT outcome to its requester — and, for a
         tokenized PUT, to every retry that joined while it was in
-        flight, recording the outcome for late retries."""
+        flight, recording the outcome (incl. the session watermark)
+        for late retries."""
         if entry is None:
             waiters = [(worker, req_id)]
         else:
             with self._tok_mu:
                 entry[0] = True
                 entry[1] = err_body
+                entry[3] = wm
                 waiters, entry[2] = entry[2], []
         for (w, rid) in waiters:
             if err_body is None:
-                self._complete(w, rid, ST_OK, 0, b"")
+                self._complete(w, rid, ST_OK, wm, b"")
             else:
                 self._complete(w, rid, ST_ERR, 0, err_body)
 
     def _handle_get(self, worker: int, req_id: int, group: int,
-                    flags: int, body: bytes) -> None:
+                    flags: int, token: int, body: bytes) -> None:
         from raftsql_tpu.runtime.db import NotLeaderError
+        # Flags bit 0 = linear, bit 1 = session (token carries the
+        # watermark), bit 2 = follower; no bit = stale local read.
+        mode = ("linear" if flags & 1 else
+                "session" if flags & 2 else
+                "follower" if flags & 4 else "local")
 
         def _run():
             try:
                 rows = self.rdb.query(body.decode("utf-8"), group,
-                                      linear=bool(flags & 1),
+                                      mode=mode, watermark=token,
                                       timeout=self.timeout_s)
             except NotLeaderError as e:
                 self._complete(worker, req_id, ST_NOT_LEADER,
@@ -499,7 +520,8 @@ class RingServer:
                 self._complete(worker, req_id, ST_ERR, 0,
                                self._err_body(e))
             else:
-                self._complete(worker, req_id, ST_OK, 0,
+                self._complete(worker, req_id, ST_OK,
+                               self._watermark(group),
                                rows.encode("utf-8"))
 
         self._read_pool.submit(_run)
@@ -573,7 +595,7 @@ class RingServer:
                                          body)
                     elif op == OP_GET:
                         self._handle_get(worker, req_id, group, flags,
-                                         body)
+                                         token, body)
                     elif op == OP_DOC:
                         self._handle_doc(worker, req_id, body)
                     elif op == OP_MEMBER:
@@ -641,6 +663,12 @@ class RingClient:
         self._pending: Dict[int, "RingFuture"] = {}
         self._stop = threading.Event()
         self.error: Optional[Exception] = None      # facade parity
+        # Session watermarks observed from ST_OK completions (the
+        # engine's leader-field echo), per group: this worker's
+        # X-Raft-Session response header source.  Monotone max — a
+        # slightly stale value only makes a session read wait less.
+        self._wm: Dict[int, int] = {}
+        self._req_group: Dict[int, int] = {}
         # Cross-process trace merge (--trace): this worker stamps each
         # ring round trip (submit -> completion, pid/worker-id tagged)
         # into a per-process segment file under the ring dir; the
@@ -670,6 +698,7 @@ class RingClient:
             req_id = self._next_id
             self._next_id += 1
             self._pending[req_id] = fut
+            self._req_group[req_id] = group
             if self._obs is not None:
                 # Submit stamp: the span closes when the completion
                 # pops (the client-visible ring round trip — HTTP
@@ -706,6 +735,14 @@ class RingClient:
                 self._cpl.pop_commit()
                 worked = True
                 fut = self._pending.pop(req_id, None)
+                g = self._req_group.pop(req_id, None)
+                if status == ST_OK and g is not None:
+                    # ST_OK's leader field is the engine's session
+                    # watermark echo — record BEFORE resolving so a
+                    # caller reading watermark(g) right after wait()
+                    # sees a value covering its own request.
+                    if leader > self._wm.get(g, 0):
+                        self._wm[g] = leader
                 if fut is not None:
                     fut._resolve(status, leader, body)
                 if self._obs is not None:
@@ -746,12 +783,28 @@ class RingClient:
             for req_id, f in list(self._pending.items()):
                 if f is fut:
                     self._pending.pop(req_id, None)
+                    self._req_group.pop(req_id, None)
                     return
 
+    def watermark(self, group: int = 0) -> int:
+        """Session watermark for this worker's X-Raft-Session response
+        header: the newest engine watermark observed on this worker's
+        own completions (monotone; covers every request this worker
+        has acked)."""
+        return self._wm.get(group, 0)
+
     def query(self, query: str, group: int = 0, linear: bool = False,
-              timeout: float = 10.0) -> str:
+              timeout: float = 10.0, mode: Optional[str] = None,
+              watermark: int = 0) -> str:
         from raftsql_tpu.runtime.db import NotLeaderError
-        fut = self._submit(OP_GET, group, 1 if linear else 0, 0,
+        if mode is None:
+            mode = "linear" if linear else "local"
+        flags = {"local": 0, "linear": 1, "session": 2,
+                 "follower": 4}.get(mode)
+        if flags is None:
+            raise ValueError(f"unknown read mode {mode!r}")
+        fut = self._submit(OP_GET, group, flags,
+                           max(int(watermark), 0),
                            query.encode("utf-8"))
         status, leader, body = fut.wait_raw(timeout)
         if status == ST_OK:

@@ -53,7 +53,8 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
                data_prefix: str = "raftsql", resume: bool = False,
                compact_every: int = 0, compact_keep: int = 1024,
                wal_segment_bytes: int = 4 << 20,
-               trace: bool = False) -> RaftDB:
+               trace: bool = False, lease_ticks: int = 0,
+               max_clock_skew: int = 1) -> RaftDB:
     peers = cluster.split(",")
     # Default election/heartbeat timing is REAL-TIME parity with the
     # reference (~1 s election timeout, ~100 ms heartbeat at its 100 ms
@@ -74,10 +75,18 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
         # meaningless — keep the reference's tick counts (raft.go:154-155).
         election_ticks = election_ticks or 10
         heartbeat_ticks = 1
+    # Leader leases (config.py lease_ticks): clamp to the safe bound
+    # for a rate-bounded deployment — an operator-supplied lease can
+    # never exceed what the election timeout can protect.
+    if lease_ticks:
+        lease_ticks = min(lease_ticks,
+                          max(1, election_ticks - max_clock_skew - 1))
     cfg = RaftConfig(num_groups=groups, num_peers=len(peers),
                      tick_interval_s=tick, election_ticks=election_ticks,
                      heartbeat_ticks=heartbeat_ticks,
-                     wal_segment_bytes=wal_segment_bytes)
+                     wal_segment_bytes=wal_segment_bytes,
+                     lease_ticks=lease_ticks,
+                     max_clock_skew=max_clock_skew)
     transport = TcpTransport(peers, node_id - 1)
     pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
                            data_dir=f"{data_prefix}-{node_id}")
@@ -301,6 +310,16 @@ def main(argv=None) -> None:
                          "(runtime/ring.py), all binding --port via "
                          "SO_REUSEPORT.  0 = serve HTTP in-process "
                          "(the classic single-process deployment)")
+    ap.add_argument("--lease-ticks", type=int, default=0,
+                    help="leader-lease duration in ticks (0 = off): "
+                         "linearizable reads at a leader whose lease "
+                         "covers now + --max-clock-skew skip the "
+                         "ReadIndex quorum round.  Clamped below the "
+                         "election timeout; requires bounded relative "
+                         "clock rates (config.py lease_ticks)")
+    ap.add_argument("--max-clock-skew", type=int, default=1,
+                    help="clock-skew slack (ticks) subtracted from "
+                         "every lease validity check")
     ap.add_argument("--http-engine", choices=("aio", "threaded"),
                     default="aio",
                     help="HTTP plane: single-thread event loop with "
@@ -361,7 +380,9 @@ def main(argv=None) -> None:
                          compact_every=args.compact_every,
                          compact_keep=args.compact_keep,
                          wal_segment_bytes=args.wal_segment_bytes,
-                         trace=args.trace)
+                         trace=args.trace,
+                         lease_ticks=args.lease_ticks,
+                         max_clock_skew=args.max_clock_skew)
     _watch_fatal(rdb)
     if args.workers > 0:
         _serve_workers(rdb, args)

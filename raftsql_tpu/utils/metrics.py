@@ -40,6 +40,20 @@ class NodeMetrics:
     # device window (runtime/hostplane.py overlap pipeline).
     wal_group_commits: int = 0
     overlap_ticks: int = 0
+    # Read-plane counters (the lease/ReadIndex/session read plane,
+    # runtime/db.py query modes): how each served read was satisfied,
+    # plus the lease lifecycle — grants (a linear read served straight
+    # from a live lease), expiries (a leader held the read path but its
+    # lease had lapsed), degrades (a linear read fell back from the
+    # lease fast path to a full ReadIndex quorum round).
+    reads_local: int = 0
+    reads_session: int = 0
+    reads_follower: int = 0
+    reads_lease: int = 0
+    reads_read_index: int = 0
+    lease_grants: int = 0
+    lease_expiries: int = 0
+    lease_degrades: int = 0
     # Fault counters (chaos/ harness + storage fsio shim): injected
     # message-plane faults and storage faults survived by this node.
     # Zero outside chaos runs; exported so a chaos'd deployment's
@@ -86,6 +100,16 @@ class NodeMetrics:
             "conf_changes_applied": self.conf_changes_applied,
             "wal_group_commits": self.wal_group_commits,
             "overlap_ticks": self.overlap_ticks,
+            "reads": {
+                "local": self.reads_local,
+                "session": self.reads_session,
+                "follower": self.reads_follower,
+                "lease": self.reads_lease,
+                "read_index": self.reads_read_index,
+                "lease_grants": self.lease_grants,
+                "lease_expiries": self.lease_expiries,
+                "lease_degrades": self.lease_degrades,
+            },
             "faults": {
                 "dropped_msgs": self.faults_dropped_msgs,
                 "delayed_msgs": self.faults_delayed_msgs,

@@ -103,3 +103,79 @@ def test_replay_publishes_only_committed_prefix(tmp_path):
     assert sqls.count("SET won-write") == 1, sqls
     # The committed prefix itself did replay.
     assert sqls[0] == "SET shared", sqls
+
+
+def test_forward_reclaimed_when_follower_becomes_leader(tmp_path):
+    """A proposal forwarded to a leader that died in the same instant
+    must NOT sit in forward-limbo until the retry deadline when the
+    proposing follower itself wins the next election: the new leader
+    reclaims its own in-flight forwards immediately (envelope dedup
+    makes the requeue safe).  Found by the process-plane read nemesis:
+    the entry node's PUT stalled for the whole deadline while it was
+    the leader that could have committed it."""
+    import numpy as np
+    from raftsql_tpu.config import LEADER
+    from raftsql_tpu.runtime.db import _expand_commit_item
+
+    cfg = RaftConfig(num_groups=1, num_peers=3, log_window=64,
+                     max_entries_per_msg=4, election_ticks=10,
+                     heartbeat_ticks=1, tick_interval_s=0.0)
+    hub = LoopbackHub()
+    nodes = [RaftNode(i + 1, 3, cfg, LoopbackTransport(hub),
+                      str(tmp_path / f"n{i + 1}"))
+             for i in range(3)]
+    try:
+        for n in nodes:
+            n.start(threaded=False)
+        lead = None
+        for _ in range(300):
+            for n in nodes:
+                n.tick()
+            lead = next((i for i, n in enumerate(nodes)
+                         if n._last_role[0] == LEADER), None)
+            if lead is not None and all(
+                    n.leader_of(0) == lead for n in nodes):
+                break
+        assert lead is not None
+        fwd = (lead + 1) % 3         # the proposing follower
+        other = (lead + 2) % 3
+        # Propose at the follower, then kill the leader BEFORE the
+        # follower's next tick delivers anywhere useful: the forward
+        # targets a dead node and is lost.
+        nodes[fwd].propose(0, b"SET k reclaimed")
+        from raftsql_tpu.chaos.scenarios import hard_crash_node
+        hard_crash_node(nodes[lead])
+        dead, nodes[lead] = nodes[lead], None
+        # Bias the PROPOSING follower to win the next election (its
+        # timers run 2x) — the reclaim-on-become-leader path.
+        committed = {}
+        for t in range(35):
+            for i, n in enumerate(nodes):
+                if n is None:
+                    continue
+                n.tick(timer_inc=2 if i == fwd else 1)
+            while True:
+                try:
+                    item = nodes[fwd].commit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None or item is CLOSED:
+                    continue
+                for (g, idx, sql) in _expand_commit_item(
+                        item, nodes[fwd]):
+                    committed[(g, idx)] = sql
+            if "SET k reclaimed" in committed.values():
+                break
+        # Old behavior: the forward sat in limbo until the retry
+        # deadline (4 * election_ticks = 40 ticks) — far beyond this
+        # window.  With the reclaim, the new leader commits it right
+        # after its election.
+        assert "SET k reclaimed" in committed.values(), (
+            f"forwarded proposal not reclaimed by the new leader "
+            f"within 35 ticks (committed: {sorted(committed)})")
+    finally:
+        for n in nodes:
+            if n is not None:
+                n.stop()
+        if dead is not None:
+            dead.stop()

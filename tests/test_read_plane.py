@@ -1,0 +1,318 @@
+"""The lease-based read plane (config.lease_ticks) and its chaos
+falsification harness.
+
+Covers the PR's acceptance spine:
+  - leader leases serve linearizable reads without a quorum round, and
+    metrics attribute every read to its mode;
+  - a partitioned leader's lease EXPIRES (never a silent stale read),
+    and the degraded path surfaces typed, retryable errors within the
+    request timeout;
+  - session (X-Raft-Session) and follower watermark reads give
+    read-your-writes at any replica;
+  - ReadIndex/lease quorum confirmation under JOINT consensus needs
+    both halves of the config;
+  - the read nemesis (chaos/scenarios.py ReadNemesisRunner) and the
+    lease FALSIFICATION pair: a deliberately mis-sized lease bound
+    under 4x clock skew must be CAUGHT by the read-linearizability
+    invariant, and the same schedule with a correct bound must pass.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raftsql_tpu.config import LEADER, RaftConfig
+from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+from raftsql_tpu.runtime.db import NotLeaderError, RaftDB, ReadTimeout
+from raftsql_tpu.runtime.pipe import RaftPipe
+from raftsql_tpu.transport.loopback import (FaultPlan, LoopbackHub,
+                                            LoopbackTransport)
+
+TICK = 0.005
+TIMEOUT = 30.0
+
+
+@pytest.fixture
+def lease_cluster(tmp_path):
+    """3-node loopback cluster with leases ON, sized safely for the
+    lockstep (rate-1) clock: lease 6 + skew 1 < election 10."""
+    faults = FaultPlan()
+    hub = LoopbackHub(faults=faults)
+    cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=TICK,
+                     election_ticks=10, log_window=64,
+                     max_entries_per_msg=4,
+                     lease_ticks=6, max_clock_skew=1)
+    dbs = []
+    for i in range(3):
+        pipe = RaftPipe.create(
+            i + 1, 3, cfg, LoopbackTransport(hub),
+            data_dir=os.path.join(str(tmp_path), f"raftsql-{i + 1}"))
+        dbs.append(RaftDB(
+            lambda g, i=i: SQLiteStateMachine(
+                os.path.join(str(tmp_path), f"db-{i}.db")),
+            pipe, num_groups=1))
+    yield dbs, faults
+    for db in dbs:
+        try:
+            db.close()
+        except Exception:
+            pass
+
+
+def _leader(dbs, timeout=TIMEOUT) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for i, db in enumerate(dbs):
+            if db.pipe.node._last_role[0] == LEADER:
+                return i
+        time.sleep(0.02)
+    raise AssertionError("no leader elected")
+
+
+def test_lease_serves_linear_reads(lease_cluster):
+    """At a healthy leader, linearizable reads ride the lease (no
+    quorum round), read-your-writes holds, and the /metrics read
+    counters attribute the path."""
+    dbs, _ = lease_cluster
+    assert dbs[0].propose("CREATE TABLE t (v text)").wait(TIMEOUT) is None
+    lead = _leader(dbs)
+    node = dbs[lead].pipe.node
+    for k in range(4):
+        assert dbs[lead].propose(
+            f"INSERT INTO t (v) VALUES ('k{k}')").wait(TIMEOUT) is None
+        got = dbs[lead].query("SELECT count(*) FROM t", mode="linear",
+                              timeout=TIMEOUT)
+        assert got == f"|{k + 1}|\n", got
+    m = node.metrics
+    # At a healthy heartbeat-confirmed leader the lease covers most of
+    # these reads; any degrade must have gone through ReadIndex, never
+    # served stale.
+    assert m.reads_lease + m.reads_read_index == 4
+    assert m.reads_lease >= 1
+    assert m.lease_grants >= 1
+    # The metrics doc nests them under "reads" (prom round-trip).
+    doc = dbs[lead].metrics()
+    assert doc["reads"]["lease"] == m.reads_lease
+
+
+def test_lease_expires_under_partition_typed_timeout(lease_cluster):
+    """A leader cut from its quorum must LOSE its lease within the
+    bound (no silent stale read), and the degraded ReadIndex round
+    must surface a TYPED retryable error within the request timeout —
+    the bounded-poll-loop satellite."""
+    dbs, faults = lease_cluster
+    assert dbs[0].propose("CREATE TABLE t (v text)").wait(TIMEOUT) is None
+    lead = _leader(dbs)
+    node = dbs[lead].pipe.node
+    # Healthy: the lease is live.
+    dbs[lead].query("SELECT count(*) FROM t", mode="linear",
+                    timeout=TIMEOUT)
+    faults.isolate(lead + 1, range(1, 4))
+    # Wait out the lease bound (lease_ticks + skew, in ticks) plus the
+    # in-flight echo window.
+    time.sleep(30 * TICK)
+    assert node.lease_read(0) is None, \
+        "partitioned leader still claims a lease past its bound"
+    t0 = time.monotonic()
+    with pytest.raises((TimeoutError, NotLeaderError)) as ei:
+        dbs[lead].query("SELECT count(*) FROM t", mode="linear",
+                        timeout=1.5)
+    took = time.monotonic() - t0
+    assert took < 5.0, f"read poll did not respect its timeout ({took})"
+    if isinstance(ei.value, TimeoutError):
+        # The typed class names the stalled phase for client logs.
+        assert isinstance(ei.value, ReadTimeout)
+        assert ei.value.phase in ("confirm", "read_index")
+    assert node.metrics.lease_expiries >= 1
+    faults.heal()
+
+
+def test_session_read_your_writes_any_replica(lease_cluster):
+    """A session read presenting the write's watermark must see it at
+    ANY replica — the X-Raft-Session contract."""
+    dbs, _ = lease_cluster
+    assert dbs[0].propose("CREATE TABLE t (v text)").wait(TIMEOUT) is None
+    lead = _leader(dbs)
+    assert dbs[lead].propose(
+        "INSERT INTO t (v) VALUES ('mine')").wait(TIMEOUT) is None
+    wm = dbs[lead].watermark(0)
+    assert wm >= 2
+    for i in range(3):
+        got = dbs[i].query("SELECT count(*) FROM t", mode="session",
+                           watermark=wm, timeout=TIMEOUT)
+        assert got == "|1|\n", (i, got)
+    m = dbs[(lead + 1) % 3].pipe.node.metrics
+    assert m.reads_session >= 1
+
+
+def test_follower_mode_reads_at_commit_watermark(lease_cluster):
+    """mode="follower": the replica serves once its apply reaches its
+    OWN commit watermark — fresher than a stale local read, no leader
+    round.  A follower that has replicated the write must return it."""
+    dbs, _ = lease_cluster
+    assert dbs[0].propose("CREATE TABLE t (v text)").wait(TIMEOUT) is None
+    lead = _leader(dbs)
+    assert dbs[lead].propose(
+        "INSERT INTO t (v) VALUES ('x')").wait(TIMEOUT) is None
+    follower = (lead + 1) % 3
+    deadline = time.monotonic() + TIMEOUT
+    while True:
+        got = dbs[follower].query("SELECT count(*) FROM t",
+                                  mode="follower", timeout=TIMEOUT)
+        if got == "|1|\n":
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(f"follower never caught up: {got!r}")
+        time.sleep(0.05)
+    assert dbs[follower].pipe.node.metrics.reads_follower >= 1
+
+
+def test_unknown_read_mode_rejected(lease_cluster):
+    dbs, _ = lease_cluster
+    with pytest.raises(ValueError, match="unknown read mode"):
+        dbs[0].query("SELECT 1", mode="strong")
+
+
+def test_joint_consensus_confirmation_needs_both_halves():
+    """ReadIndex confirmation AND the lease quorum clock under a joint
+    C_old,new config must have a majority of BOTH masks — a read
+    served on one half alone could miss a leader the other half
+    elected mid-membership-change."""
+    from raftsql_tpu.membership import MembershipManager
+    mm = MembershipManager(4, 1, initial_voters=(0, 1, 2))
+    entry = mm.make_change(0, "add_learner", 3)
+    assert mm.apply(0, 5, entry) is not None
+    entry = mm.make_change(0, "promote", 3)  # -> joint {0,1,2,3}/{0,1,2}
+    assert mm.apply(0, 6, entry) is not None
+    assert mm.config(0).is_joint
+
+    # quorum_confirmed: self=0.  {0,1} confirms old (2 of {0,1,2}) but
+    # not new (2 of 4 needs 3) -> must NOT confirm.
+    ok = np.array([False, True, False, False])
+    assert not mm.quorum_confirmed(0, ok, 0)
+    # {0,1,3} confirms both halves.
+    ok = np.array([False, True, False, True])
+    assert mm.quorum_confirmed(0, ok, 0)
+
+    # quorum_nth (the lease clock): the min of both masks' majorities.
+    vals = np.array([100, 90, 0, 95])        # peer 2 never confirmed
+    # old {0,1,2}: 2nd largest of (100,90,0) = 90; new {0,1,2,3}: 3rd
+    # largest of (100,90,0,95) = 90.
+    assert mm.quorum_nth(0, vals) == 90
+    vals = np.array([100, 0, 0, 95])
+    # old majority falls to 0 -> the stale half gates the lease.
+    assert mm.quorum_nth(0, vals) == 0
+
+
+def test_masked_lease_kernel_joint_min():
+    """Device-side: the joint lease clock is the min of both masks'
+    quorum values (core/step.py Phase 8b uses exactly this pair)."""
+    import jax.numpy as jnp
+    from raftsql_tpu.ops.quorum import masked_quorum_match_index
+    resp = jnp.asarray([[50, 40, 0, 45]])
+    new = jnp.asarray([[True, True, True, True]])
+    old = jnp.asarray([[True, True, True, False]])
+    q = jnp.minimum(masked_quorum_match_index(resp, new),
+                    masked_quorum_match_index(resp, old))
+    # new: 3rd largest of (50,40,0,45)=40; old: 2nd of (50,40,0)=40.
+    assert int(q[0]) == 40
+
+
+def test_fused_device_lease_lifecycle(tmp_path):
+    """The fused runtime's [G] lease column: a healthy leader's device
+    lease stays ahead of the step clock; with leases disabled the
+    column is all zero (the compiled-in-but-disabled contract)."""
+    from raftsql_tpu.runtime.fused import FusedClusterNode
+    for lease_ticks in (4, 0):
+        cfg = RaftConfig(num_groups=2, num_peers=3, log_window=32,
+                         max_entries_per_msg=4, election_ticks=10,
+                         heartbeat_ticks=1, tick_interval_s=0.0,
+                         lease_ticks=lease_ticks, max_clock_skew=0)
+        node = FusedClusterNode(
+            cfg, os.path.join(str(tmp_path), f"lease{lease_ticks}"))
+        try:
+            for _ in range(60):
+                node.tick()
+            node.publish_flush()
+            lc = node._lease_col
+            assert lc is not None
+            if lease_ticks == 0:
+                assert (lc == 0).all()
+                assert node.lease_read(0) is None
+            else:
+                hints = node._hints
+                assert (hints >= 0).all()
+                for g in range(2):
+                    p = int(hints[g])
+                    assert int(lc[p, g]) > node._device_steps, \
+                        (g, lc[:, g], node._device_steps)
+                    assert node.lease_read(g) is not None
+                assert node.metrics.lease_grants >= 2
+        finally:
+            node.stop()
+
+
+def test_lease_falsification_broken_bound_is_caught(tmp_path):
+    """THE sensitivity proof: a lease sized for zero skew, run under
+    4x clock skew behind a leader partition, must produce a stale
+    lease read that the read-linearizability invariant CATCHES."""
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+    from raftsql_tpu.chaos.scenarios import ReadNemesisRunner
+    from raftsql_tpu.chaos.schedule import falsification_plan
+    os.environ["RAFTSQL_FLIGHT_DIR"] = str(tmp_path)
+    try:
+        plan = falsification_plan(0, broken=True)
+        with pytest.raises(InvariantViolation, match="STALE"):
+            ReadNemesisRunner(plan,
+                              os.path.join(str(tmp_path), "bad")).run()
+    finally:
+        os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+
+
+@pytest.mark.slow
+def test_lease_falsification_correct_bound_passes(tmp_path):
+    """The control arm: the SAME schedule with a correctly sized bound
+    passes, with leases actually granted — the invariant keys on the
+    bound, not on chaos in general."""
+    from raftsql_tpu.chaos.scenarios import ReadNemesisRunner
+    from raftsql_tpu.chaos.schedule import falsification_plan
+    plan = falsification_plan(0, broken=False)
+    r = ReadNemesisRunner(plan, os.path.join(str(tmp_path), "ok")).run()
+    assert r["lease_reads"] > 0
+    assert r["reads_checked"] > 0
+
+
+@pytest.mark.slow
+def test_read_nemesis_family_deterministic(tmp_path):
+    """The seeded read nemesis: every read family fires, invariants
+    hold, and two runs of one seed digest-match (the `make
+    chaos-reads` gate in miniature)."""
+    from raftsql_tpu.chaos.scenarios import ReadNemesisRunner
+    from raftsql_tpu.chaos.schedule import generate_reads
+    plan = generate_reads(0, ticks=160)
+    r1 = ReadNemesisRunner(plan,
+                           os.path.join(str(tmp_path), "r1")).run()
+    r2 = ReadNemesisRunner(plan,
+                           os.path.join(str(tmp_path), "r2")).run()
+    assert r1["result_digest"] == r2["result_digest"]
+    assert r1["lease_reads"] > 0
+    assert r1["session_reads"] > 0
+    assert r1["follower_reads"] > 0
+    assert r1["reads_by_mode"].get("linear", 0) > 0
+
+
+@pytest.mark.slow
+def test_proc_read_nemesis(tmp_path):
+    """Process-plane read nemesis: linear/session/follower HTTP reads
+    race real SIGKILLs/stalls/storms; no stale session read, no
+    unscripted death."""
+    from raftsql_tpu.chaos.proc import ProcReadChaosRunner
+    from raftsql_tpu.chaos.schedule import generate_procs
+    plan = generate_procs(3, ticks=40)
+    r = ProcReadChaosRunner(plan, str(tmp_path)).run()
+    assert r["linear_reads"] > 0
+    assert r["session_reads"] > 0
+    assert r["follower_reads"] > 0
+    assert r["stale_session"] == 0
+    assert r["unexpected_exits"] == 0

@@ -239,3 +239,91 @@ def test_workers_deployment_end_to_end(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_ring_linear_get_421_redirect_workers_cluster(tmp_path):
+    """Ring op 2 (GET) with flags bit 0 (linearizable) through a REAL
+    --workers 2 deployment of a DISTRIBUTED 2-node cluster: a linear
+    read at the follower's workers crosses the ring, comes back
+    ST_NOT_LEADER, surfaces as HTTP 421 + X-Raft-Leader, and the
+    hardened client chases the hint to the leader — plus the
+    X-Raft-Session watermark echo (session reads) over the same ring.
+    """
+    from raftsql_tpu.api.client import RaftSQLClient
+
+    peer_ports = [_free_port(), _free_port()]
+    http_ports = [_free_port(), _free_port()]
+    cluster = ",".join(f"http://127.0.0.1:{p}" for p in peer_ports)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for i in (0, 1):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "raftsql_tpu.server.main",
+             "--id", str(i + 1), "--cluster", cluster,
+             "--port", str(http_ports[i]), "--workers", "2",
+             "--tick", "0.01", "--lease-ticks", "30"],
+            cwd=str(tmp_path), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    client = RaftSQLClient(http_ports, timeout_s=10)
+    try:
+        for i in (0, 1):
+            client.wait_healthy(i, deadline_s=120)
+        client.put("CREATE TABLE t (v text)", deadline_s=60)
+        wm = client.put("INSERT INTO t (v) VALUES ('a')", deadline_s=30)
+        assert wm is not None and wm >= 2     # session echo over the ring
+
+        # Find the leader from /healthz (role of group 0).
+        lead = None
+        deadline = 30
+        import time as _t
+        t0 = _t.monotonic()
+        while lead is None and _t.monotonic() - t0 < deadline:
+            for i in (0, 1):
+                doc = client.health(i, timeout_s=2.0)
+                if doc and doc["groups"]["0"]["role"] == "leader":
+                    lead = i
+                    break
+            _t.sleep(0.1)
+        assert lead is not None, "no leader reported via /healthz"
+        follower = 1 - lead
+
+        # Raw linear GET pinned at the FOLLOWER's workers: the ring
+        # completion must be NOT_LEADER -> 421 + X-Raft-Leader.
+        status, hdrs, _ = client.raw(
+            follower, "GET", "/", "SELECT count(*) FROM t",
+            headers={"X-Consistency": "linear"})
+        assert status == 421
+        assert hdrs.get("X-Raft-Leader") == str(lead + 1)
+
+        # The hardened client chases the hint and reads linearizably.
+        got = client.get("SELECT count(*) FROM t", linear=True,
+                         deadline_s=30)
+        assert got == "|1|\n", got
+
+        # Session read presenting the PUT's watermark works from the
+        # follower too (no leader round).
+        got = client.get("SELECT count(*) FROM t", node=follower,
+                         consistency="session", session=wm,
+                         deadline_s=30)
+        assert got == "|1|\n", got
+
+        # The leader's engine attributes the linear read (lease or
+        # ReadIndex — never unaccounted).
+        _, _, text = client.raw(lead, "GET", "/metrics")
+        m = json.loads(text)
+        assert m["reads"]["lease"] + m["reads"]["read_index"] >= 1
+        _, _, text = client.raw(follower, "GET", "/metrics")
+        m = json.loads(text)
+        assert m["reads"]["session"] >= 1
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=30) == 0
+    finally:
+        client.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
