@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh chaos-reads trace prom-lint clean
+	chaos-mesh chaos-reads chaos-transfer trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -99,6 +99,22 @@ chaos-membership:
 chaos-reads:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --reads --seed $(SEED)
+
+# Leadership-transfer nemesis (raftsql_tpu/chaos/): graceful transfers
+# (core/step.py TimeoutNow kernel, thesis §3.10) racing drops,
+# leader-targeted partitions, one-directional cuts, clock skew and
+# crash+restart under live acked-PUT load — the fused family run twice
+# and digest-compared with a no-availability-loss-during-transfer
+# invariant (bounded proposal stall, aborted transfers leave the group
+# serving), the BROKEN-KERNEL falsification pair (a kernel that
+# abdicates before the target caught up MUST be caught on a directed
+# lagging-target schedule; the correct kernel must pass the same
+# schedule), and the process-plane POST /transfer nemesis over real
+# server processes (verdict digests compared).
+#   make chaos-transfer SEED=17
+chaos-transfer:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --transfers --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

@@ -188,6 +188,9 @@ class _Conn(asyncio.Protocol):
             elif method == b"POST" and path == b"/members":
                 self.busy = True
                 self.srv.loop.create_task(self._do_members(body))
+            elif method == b"POST" and path == b"/transfer":
+                self.busy = True
+                self.srv.loop.create_task(self._do_transfer(body))
             elif method == b"HEAD":
                 self.tr.write(_ALLOW_NOBODY)
             else:
@@ -331,6 +334,34 @@ class _Conn(asyncio.Protocol):
                 lambda: rdb.member_change(int(req.get("group", 0)),
                                           str(req.get("op", "")),
                                           int(req.get("peer", -1))))
+        except NotLeaderError as e:
+            extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
+                if e.leader > 0 else ()
+            self._finish(_resp(421, b"Misdirected Request",
+                               (str(e) + "\n").encode(), extra=extra))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        self._finish(_resp(200, b"OK",
+                           (_json.dumps(got, sort_keys=True)
+                            + "\n").encode(), b"application/json"))
+
+    async def _do_transfer(self, body: bytes) -> None:
+        """POST /transfer — graceful leadership transfer (thesis
+        §3.10), parity with api/http.py: 200 + the armed-transfer JSON,
+        421 + X-Raft-Leader at a non-leader, 400 on a refused request
+        (in-flight transfer, learner target)."""
+        import json as _json
+        rdb = self.srv.rdb
+        try:
+            req = _json.loads(body.decode("utf-8") or "{}")
+            got = await self.srv.loop.run_in_executor(
+                self.srv._read_pool,
+                lambda: rdb.transfer(int(req.get("group", 0)),
+                                     int(req.get("target", -1))))
         except NotLeaderError as e:
             extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
                 if e.leader > 0 else ()

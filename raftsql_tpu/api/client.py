@@ -214,11 +214,22 @@ class RaftSQLClient:
         return order
 
     def _note_leader(self, group: int, headers: dict) -> bool:
+        """Record a 421's X-Raft-Leader hint.  Returns True when the
+        hint names a DIFFERENT node than the cache — the caller should
+        abandon the current rotation and retry at the new leader
+        immediately (a graceful transfer moved leadership mid-request,
+        PR 11).  A 421 WITHOUT a usable hint invalidates the cache
+        instead: the node we believed led the group demonstrably does
+        not, and pinning it first would only repeat the miss."""
         hint = headers.get("X-Raft-Leader")
         if hint and hint.isdigit() and int(hint) > 0:
+            idx = (int(hint) - 1) % len(self.nodes)
             with self._mu:
-                self._leader[group] = (int(hint) - 1) % len(self.nodes)
-            return True
+                changed = self._leader.get(group) != idx
+                self._leader[group] = idx
+            return changed
+        with self._mu:
+            self._leader.pop(group, None)
         return False
 
     def _sleep_backoff(self, attempt: int, deadline: float) -> bool:
@@ -273,7 +284,12 @@ class RaftSQLClient:
                 if status == 400:
                     raise SQLError(status, text)
                 if status == 421:
-                    self._note_leader(group, hdrs)
+                    # A hint naming a node OTHER than the cached leader
+                    # means leadership moved (graceful transfer): chase
+                    # it immediately instead of finishing the rotation.
+                    if self._note_leader(group, hdrs) and node is None:
+                        last = (status, text.strip())
+                        break
                 last = (status, text.strip())
             attempt += 1
             if time.monotonic() >= deadline \

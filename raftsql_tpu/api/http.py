@@ -197,18 +197,24 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self.wfile.write(body)
 
         def do_POST(self):
-            # Membership admin write: POST /members
+            # Admin writes: POST /members
             # {"group": 0, "op": "add|add_learner|promote|remove|
-            #  remove_learner", "peer": <slot>}.  Leader-only: elsewhere
-            # answers 421 + X-Raft-Leader like linearizable reads.
-            if self.path != "/members":
+            #  remove_learner", "peer": <slot>} and POST /transfer
+            # {"group": 0, "target": <slot>} (graceful leadership
+            # transfer, thesis §3.10).  Leader-only: elsewhere answers
+            # 421 + X-Raft-Leader like linearizable reads.
+            if self.path not in ("/members", "/transfer"):
                 self._method_not_allowed()
                 return
             try:
                 req = json.loads(self._body() or "{}")
-                got = rdb.member_change(int(req.get("group", 0)),
-                                        str(req.get("op", "")),
-                                        int(req.get("peer", -1)))
+                if self.path == "/transfer":
+                    got = rdb.transfer(int(req.get("group", 0)),
+                                       int(req.get("target", -1)))
+                else:
+                    got = rdb.member_change(int(req.get("group", 0)),
+                                            str(req.get("op", "")),
+                                            int(req.get("peer", -1)))
             except NotLeaderError as e:
                 self._send(421, (str(e) + "\n").encode("utf-8"),
                            headers={"X-Raft-Leader": str(e.leader)}

@@ -52,7 +52,9 @@ from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
                                         ProcRestartStorm, ProcStall,
                                         SkewWindow,
                                         TcpChaosPlan, TcpRebindPlan,
-                                        TornWriteFault,
+                                        TornWriteFault, TransferEvent,
+                                        TransferNemesisPlan,
+                                        falsification_transfer_plan,
                                         generate, generate_asym,
                                         generate_compact,
                                         generate_corrupt_plan,
@@ -63,14 +65,17 @@ from raftsql_tpu.chaos.schedule import (LEADER_TARGET, AsymPartitionWindow,
                                         generate_snapshot_plan,
                                         generate_procs,
                                         generate_stall, generate_tcp_plan,
-                                        generate_tcp_rebind_plan)
-from raftsql_tpu.chaos.proc import ProcChaosRunner, ProcCluster
+                                        generate_tcp_rebind_plan,
+                                        generate_transfers)
+from raftsql_tpu.chaos.proc import (ProcChaosRunner, ProcCluster,
+                                    ProcTransferChaosRunner)
 from raftsql_tpu.chaos.scenarios import (FusedChaosRunner,
                                          MembershipChaosRunner,
                                          NodeClusterChaosRunner,
                                          SnapshotChaosRunner,
                                          TcpClusterChaosRunner,
-                                         TcpRebindChaosRunner)
+                                         TcpRebindChaosRunner,
+                                         TransferChaosRunner)
 
 __all__ = [
     "LEADER_TARGET", "AsymPartitionWindow", "ChaosSchedule",
@@ -85,6 +90,9 @@ __all__ = [
     "generate_membership_plan", "generate_node_plan", "generate_procs",
     "generate_skew", "generate_snapshot_plan", "generate_stall",
     "generate_tcp_plan", "generate_tcp_rebind_plan",
+    "generate_transfers", "falsification_transfer_plan",
+    "TransferEvent", "TransferNemesisPlan", "TransferChaosRunner",
+    "ProcTransferChaosRunner",
     "DurabilityLedger", "ElectionSafety", "InvariantViolation",
     "RegisterLinearizability", "RemovedQuorumSafety",
     "check_convergence", "FusedChaosRunner", "MembershipChaosRunner",

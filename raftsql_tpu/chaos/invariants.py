@@ -401,3 +401,111 @@ class RegisterLinearizability:
                             f"{mode} read({key!r}) returned STALE "
                             f"value {value!r}: a later write "
                             f"completed before the read began")
+
+
+class TransferAvailability:
+    """No availability loss during graceful leadership transfer
+    (PR 11).  Every violation message carries the TRANSFER-AVAILABILITY
+    token so the falsification harness can match on it precisely.
+
+    Checks, per issued transfer:
+      * the latch RESOLVES (completed or aborted) within the engine
+        deadline plus a two-election-cycle settling margin — a stuck
+        latch is a permanently closed group;
+      * `must_complete` transfers (the directed falsification probe)
+        must end `completed` with stall <= max_stall_ticks — the
+        broken unsafe kernel deterministically ABORTS here because the
+        behind target cannot win the election it was handed;
+      * a transfer resolving in fault-free air must be followed by a
+        committed probe write within probe_ticks (aborted transfers
+        leave the group SERVING, not just unlatched).
+
+    The runner feeds outcomes from the host's transfer event log and
+    calls check(t) every tick; crashes wipe pending state (the latch
+    dies with the process — a transfer outstanding at crash time is
+    void, not violated)."""
+
+    def __init__(self, election_ticks: int, deadline_ticks: int,
+                 max_stall_ticks: int, probe_ticks: int):
+        self.election_ticks = election_ticks
+        self.deadline_ticks = deadline_ticks
+        self.max_stall_ticks = max_stall_ticks
+        self.probe_ticks = probe_ticks
+        # group -> (issue_tick, must_complete)
+        self._pending: Dict[int, Tuple[int, bool]] = {}
+        # probe value -> (deadline_tick, group)
+        self._probes: Dict[str, Tuple[int, int]] = {}
+        self.completed = 0
+        self.aborted = 0
+        self.max_stall = 0
+        self.probes_confirmed = 0
+
+    # -- transfer lifecycle --------------------------------------------
+
+    def note_issued(self, tick: int, group: int,
+                    must_complete: bool) -> None:
+        self._pending[group] = (tick, must_complete)
+
+    def note_outcome(self, tick: int, group: int, outcome: str,
+                     stall_ticks: int) -> None:
+        issued = self._pending.pop(group, None)
+        self.max_stall = max(self.max_stall, int(stall_ticks))
+        if outcome == "completed":
+            self.completed += 1
+        else:
+            self.aborted += 1
+        if issued is None:
+            return
+        _t0, must = issued
+        if must and outcome != "completed":
+            raise InvariantViolation(
+                f"TRANSFER-AVAILABILITY: directed transfer of group "
+                f"{group} was required to complete but ended "
+                f"{outcome!r} after {stall_ticks} ticks — the engine "
+                f"deposed a leader without getting its successor "
+                f"elected")
+        if must and stall_ticks > self.max_stall_ticks:
+            raise InvariantViolation(
+                f"TRANSFER-AVAILABILITY: directed transfer of group "
+                f"{group} stalled proposals for {stall_ticks} ticks "
+                f"(bound {self.max_stall_ticks})")
+
+    def note_crash(self) -> None:
+        # Latches (and any not-yet-committed probe) die with the
+        # process; outstanding transfers are void, not violated.
+        self._pending.clear()
+        self._probes.clear()
+
+    # -- serving probes ------------------------------------------------
+
+    def arm_probe(self, tick: int, group: int, value: str) -> None:
+        self._probes[value] = (tick + self.probe_ticks, group)
+
+    def probe_committed(self, value: str) -> None:
+        if self._probes.pop(value, None) is not None:
+            self.probes_confirmed += 1
+
+    # -- per-tick / end-of-run checks ----------------------------------
+
+    def check(self, tick: int) -> None:
+        limit = self.deadline_ticks + 2 * self.election_ticks
+        for group, (t0, _must) in self._pending.items():
+            if tick - t0 > limit:
+                raise InvariantViolation(
+                    f"TRANSFER-AVAILABILITY: transfer of group {group} "
+                    f"issued at tick {t0} still unresolved at tick "
+                    f"{tick} (engine deadline {self.deadline_ticks})")
+        for value, (dl, group) in self._probes.items():
+            if tick > dl:
+                raise InvariantViolation(
+                    f"TRANSFER-AVAILABILITY: post-transfer probe write "
+                    f"{value!r} on group {group} did not commit within "
+                    f"{self.probe_ticks} ticks — the group stopped "
+                    f"serving after its transfer resolved")
+
+    def final_check(self, tick: int) -> None:
+        for group, (t0, _must) in self._pending.items():
+            raise InvariantViolation(
+                f"TRANSFER-AVAILABILITY: transfer of group {group} "
+                f"issued at tick {t0} never resolved by end of run "
+                f"({tick} ticks)")

@@ -880,3 +880,127 @@ class ProcReadChaosRunner(ProcChaosRunner):
         out = self._run_impl()
         out["result_digest"] = self._verdict_digest()
         return out
+
+
+class ProcTransferChaosRunner(ProcChaosRunner):
+    """Transfer-under-nemesis on the PROCESS plane (`make
+    chaos-transfer`): the same seeded nemesis script (SIGKILLs,
+    SIGSTOP stalls, restart storms, env disk faults) over real server
+    processes, with the acked-PUT workload interleaving graceful
+    leadership transfers driven through the public admin surface —
+    `POST /transfer` at whoever /healthz says leads group 0, then
+    polling /healthz until leadership lands on the requested target.
+
+    Reuses ProcChaosPlan unchanged (extending it would move every
+    existing proc-family digest).  A transfer outstanding when the
+    nemesis kills the leader is LOST (the latch dies with the process)
+    — counted, not failed: availability through it all is what the
+    acked-PUT stream plus convergence and the WAL post-mortem already
+    assert.  Verdict digest carries transfer-family booleans (counts
+    are wall-clock-paced)."""
+
+    XFER_EVERY = 20          # workload iterations between attempts
+    XFER_DEADLINE_S = 25.0   # generous: spans a stall + re-election
+
+    def __init__(self, plan: ProcChaosPlan, workdir: str,
+                 http_engine: str = "aio"):
+        super().__init__(plan, workdir, http_engine=http_engine)
+        self.report.update({
+            "transfers_requested": 0, "transfers_completed": 0,
+            "transfers_refused": 0, "transfers_lost": 0,
+        })
+
+    def _workload(self) -> None:
+        import random
+        rng = random.Random(self.plan.seed ^ 0x7AFE)
+        pending = None           # (target slot, wall deadline)
+        n = 0
+        while not self._stop_workload.is_set():
+            val = f"w{n}"
+            n += 1
+            try:
+                self.client.put(
+                    f"INSERT INTO chaos (v) VALUES ('{val}')",
+                    deadline_s=8.0)
+                with self._acked_lock:
+                    self.acked.append(val)
+            except (SQLError, Unavailable):
+                pass
+            except BaseException as e:   # noqa: BLE001 - surfaced
+                self._workload_err = e
+                return
+            if n % self.XFER_EVERY == 0 or pending is not None:
+                try:
+                    pending = self._transfer_cycle(rng, pending,
+                                                   issue=n %
+                                                   self.XFER_EVERY == 0)
+                except BaseException as e:   # noqa: BLE001 - surfaced
+                    self._workload_err = e
+                    return
+            time.sleep(0.08)
+
+    def _transfer_cycle(self, rng, pending, issue: bool):
+        """One observation of the transfer state machine: resolve the
+        group-0 leader from /healthz, settle an outstanding request
+        (completed / lost), and maybe issue a new one."""
+        docs = self._healthz_all()
+        lead = None
+        for i, doc in sorted(docs.items()):
+            if doc and doc["groups"].get("0", {}).get("role") == _LEADER:
+                lead = i
+                break
+        if pending is not None:
+            target, dl = pending
+            if lead == target:
+                self.report["transfers_completed"] += 1
+                return None
+            if time.monotonic() > dl:
+                # Engine abort, or the latch died with a killed
+                # leader: either way the group kept serving (the PUT
+                # stream asserts that) — log and move on.
+                self.report["transfers_lost"] += 1
+                return None
+            return pending
+        if not issue or lead is None:
+            return None
+        target = (lead + 1
+                  + rng.randrange(self.plan.peers - 1)) % self.plan.peers
+        try:
+            status, _hdrs, _text = self.client.raw(
+                lead, "POST", "/transfer",
+                body=json.dumps({"group": 0, "target": target}),
+                timeout_s=3.0)
+        except OSError:
+            return None              # leader died under us: next cycle
+        if status == 200:
+            self.report["transfers_requested"] += 1
+            return (target, time.monotonic() + self.XFER_DEADLINE_S)
+        # 400 = engine refusal (latch in flight, learner target);
+        # 421 = our /healthz view was stale — both retry next cycle.
+        self.report["transfers_refused"] += 1
+        return None
+
+    def _verdict_digest(self) -> str:
+        """What must reproduce for the transfer nemesis: the schedule,
+        the invariant verdicts, and the transfer-family booleans.  The
+        base storage-fault booleans are excluded for the same reason
+        ProcReadChaosRunner excludes them — their op thresholds
+        accumulate with the wall-clock-paced workload."""
+        r = self.report
+        doc = {
+            "schedule": self.plan.digest(),
+            "invariants": dict(self.verdicts),
+            "transfer_families": {
+                "requested": r["transfers_requested"] > 0,
+                "completed": r["transfers_completed"] > 0,
+                "unexpected_exits": r["unexpected_exits"] == 0,
+            },
+        }
+        blob = json.dumps(doc, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def run(self) -> dict:
+        out = self._run_impl()
+        out["result_digest"] = self._verdict_digest()
+        return out

@@ -77,6 +77,12 @@ def _run_reads(plan) -> dict:
         return ReadNemesisRunner(plan, d).run()
 
 
+def _run_transfers(plan) -> dict:
+    from raftsql_tpu.chaos.scenarios import TransferChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return TransferChaosRunner(plan, d).run()
+
+
 def _check(ok: bool, msg: str) -> bool:
     if not ok:
         print(f"CHAOS FAIL: {msg}", file=sys.stderr)
@@ -139,6 +145,12 @@ def _family_specs():
                   and r["follower_reads"] > 0
                   and r["reads_by_mode"].get("linear", 0) > 0
                   and r["skew_ticks"] > 0 and r["crashes"] >= 1),
+        "transfers": (lambda seed: _run_transfers(
+                          S.generate_transfers(seed)),
+                      True, lambda r: r["transfers_requested"] >= 6
+                      and r["transfers_completed"] >= 1
+                      and r["transfer_probes_confirmed"] >= 1
+                      and r["partitions"] >= 1 and r["crashes"] >= 1),
     }
 
 
@@ -285,6 +297,114 @@ def run_reads(seed: int, runs: int = 2,
     return 0 if ok else 1
 
 
+def run_transfers(seed: int, runs: int = 2,
+                  with_procs: bool = True) -> int:
+    """`make chaos-transfer`: the leadership-transfer gauntlet.
+
+    1. The fused transfer nemesis (family `transfers`), run twice —
+       graceful transfers race drops, leader-targeted partitions, asym
+       cuts, skew and crash+restart under acked-PUT load; schedule +
+       result digests must reproduce and the TransferAvailability /
+       election-safety / durability invariants must hold.
+    2. The FALSIFICATION pair (schedule.py falsification_transfer_plan):
+       the deliberately broken kernel (unsafe_transfer — abdicate
+       before the target caught up, the thesis-§3.10 mistake) MUST be
+       caught by TransferAvailability on a directed lagging-target
+       schedule, and the SAME schedule with the correct kernel must
+       pass with the transfer completed — proving the harness detects
+       exactly the broken handoff, not chaos in general.
+    3. The process-plane transfer nemesis (chaos/proc.py
+       ProcTransferChaosRunner): POST /transfer against real server
+       processes under the seeded nemesis script; verdict digests must
+       reproduce.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    fired = _family_specs()["transfers"][2]
+    reports = []
+    for run in range(runs):
+        r = _run_transfers(S.generate_transfers(seed))
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(fired(r),
+                     f"transfers: a transfer family never fired ({r})")
+    digests = {(r["schedule_digest"], r["result_digest"])
+               for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"transfers: non-reproducible: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_transfers(
+                    S.falsification_transfer_plan(seed, broken=True))
+            except InvariantViolation as e:
+                caught = "TRANSFER-AVAILABILITY" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the BROKEN transfer kernel "
+                         "was NOT caught by TransferAvailability")
+    try:
+        r = _run_transfers(
+            S.falsification_transfer_plan(seed, broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the CORRECT "
+                           f"transfer kernel tripped the invariant: "
+                           f"{e}")
+    else:
+        ok &= _check(r["transfers_completed"] >= 1,
+                     "falsification control: the directed transfer "
+                     "never completed")
+        print(json.dumps(
+            {"falsification_control": "passed",
+             "max_transfer_stall": r["max_transfer_stall"]}))
+
+    if with_procs:
+        from raftsql_tpu.chaos.proc import ProcTransferChaosRunner
+        plan = S.generate_procs(seed, ticks=60)
+        preports = []
+        for run in range(runs):
+            with tempfile.TemporaryDirectory(
+                    prefix="raftsql-transfer-procs-") as d:
+                r = ProcTransferChaosRunner(plan, d).run()
+            r["run"] = run
+            preports.append(r)
+            print(json.dumps(r, sort_keys=True))
+        for r in preports:
+            ok &= _check(r["transfers_requested"] > 0
+                         and r["transfers_completed"] > 0,
+                         f"transfer-procs: no transfer completed over "
+                         f"the public surface ({r})")
+            ok &= _check(r["unexpected_exits"] == 0,
+                         f"transfer-procs: unscripted server death "
+                         f"({r})")
+        pdig = {(r["schedule_digest"], r["result_digest"])
+                for r in preports}
+        ok &= _check(len(pdig) == 1,
+                     f"transfer-procs: non-reproducible verdicts: "
+                     f"{pdig}")
+    if ok:
+        print(f"chaos transfers ok: seed={seed} "
+              f"schedule={reports[0]['schedule_digest']} "
+              f"result={reports[0]['result_digest']} "
+              f"falsification=caught")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -333,8 +453,14 @@ def main(argv=None) -> int:
                          "nemesis run twice + the lease-falsification "
                          "sensitivity pair + the process-plane read "
                          "nemesis")
+    ap.add_argument("--transfers", action="store_true",
+                    help="transfer-plane nemesis (make chaos-transfer):"
+                         " the fused transfer-under-nemesis family run "
+                         "twice + the broken-kernel falsification pair "
+                         "+ the process-plane POST /transfer nemesis")
     ap.add_argument("--no-procs", action="store_true",
-                    help="with --reads: skip the process-plane leg")
+                    help="with --reads/--transfers: skip the "
+                         "process-plane leg")
     ap.add_argument("--proc-ticks", type=int,
                     default=int(os.environ.get("PROC_TICKS", "80")),
                     help="host ticks for the --procs script phase")
@@ -344,6 +470,9 @@ def main(argv=None) -> int:
     if args.reads:
         return run_reads(args.seed, runs=args.runs,
                          with_procs=not args.no_procs)
+    if args.transfers:
+        return run_transfers(args.seed, runs=args.runs,
+                             with_procs=not args.no_procs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

@@ -782,6 +782,193 @@ class ReadNemesisRunner(FusedChaosRunner):
         return r
 
 
+class TransferChaosRunner(FusedChaosRunner):
+    """The transfer-under-nemesis family (fused plane): graceful
+    leadership transfers (runtime/hostplane.py transfer_leadership →
+    core/step.py TimeoutNow kernel) race drops, leader-targeted
+    partitions, one-directional cuts, clock skew, and crash+restart
+    while the acked-PUT workload keeps running — checked by the
+    TransferAvailability invariant on top of the standing election-
+    safety / durability / linearizability checks:
+
+      * every accepted transfer RESOLVES (completed or aborted) within
+        the engine deadline plus a two-election settling margin;
+      * a transfer resolving in fault-free air is followed by a probe
+        write that must commit within probe_ticks — aborted transfers
+        leave the group SERVING, not just unlatched;
+      * `must_complete` transfers (falsification_transfer_plan) must
+        end `completed`: the deliberately broken kernel
+        (cfg.unsafe_transfer — abdicate before the target caught up,
+        the §3.10 mistake) hands the election to a peer that cannot
+        win it, leadership settles elsewhere, the host records an
+        ABORT, and the invariant fires — proving the harness catches
+        the broken kernel, not chaos in general.
+
+    Transfer requests are retried each tick while the engine refuses
+    them (no leader during a partition, latch already in flight …);
+    a request refused for XFER_RETRY_TICKS straight is dropped and
+    counted — refusals are load-shedding, not failures.  Fully
+    deterministic: same seeded draws as the base runner, digests
+    compared across runs by `make chaos-transfer`."""
+
+    XFER_RETRY_TICKS = 60
+
+    def __init__(self, plan, data_dir: str):
+        from raftsql_tpu.chaos.invariants import TransferAvailability
+        from raftsql_tpu.chaos.schedule import ChaosSchedule as _CS
+        sched = _CS(seed=plan.seed, ticks=plan.ticks,
+                    drops=plan.drops, partitions=plan.partitions,
+                    asym_partitions=plan.asym_partitions,
+                    skews=plan.skews, crashes=plan.crashes,
+                    prop_rate=plan.prop_rate, read_rate=plan.read_rate)
+        cfg = RaftConfig(num_groups=plan.groups, num_peers=plan.peers,
+                         log_window=64, max_entries_per_msg=4,
+                         election_ticks=plan.election_ticks,
+                         heartbeat_ticks=1, tick_interval_s=0.0,
+                         unsafe_transfer=plan.unsafe_transfer)
+        super().__init__(sched, data_dir, cfg=cfg)
+        self.plan = plan
+        self.avail = TransferAvailability(
+            election_ticks=plan.election_ticks,
+            deadline_ticks=plan.deadline_ticks,
+            max_stall_ticks=plan.max_stall_ticks,
+            probe_ticks=plan.probe_ticks)
+        # Plan events still waiting to be accepted by the engine.
+        self._xfer_todo = list(plan.transfers)
+        self._seen_events = 0       # consumed prefix of _xfer_events
+        self.report.update({
+            "transfers_requested": 0, "transfers_completed": 0,
+            "transfers_aborted": 0, "transfer_refusals": 0,
+            "transfer_drops": 0, "transfer_probes": 0,
+            "transfer_probes_confirmed": 0, "max_transfer_stall": 0,
+        })
+
+    # -- transfer issuance ----------------------------------------------
+
+    def _resolve_event(self, ev) -> Optional[Tuple[int, int]]:
+        """(group, target) for a plan event, or None to retry later.
+        target -1 = the leader's successor slot; XFER_LAGGER = the peer
+        the first partition window isolated (known once the window has
+        opened); group -1 = lowest group led by someone other than the
+        resolved target."""
+        from raftsql_tpu.chaos.schedule import XFER_LAGGER
+        node = self.node
+        target = ev.target
+        if target == XFER_LAGGER:
+            lag = self._part_peer.get(0)
+            if lag is None:
+                return None          # window not open yet: retry
+            target = lag
+        group = ev.group
+        if group < 0:
+            for g in range(self.cfg.num_groups):
+                lead = node.leader_of(g)
+                if lead >= 0 and lead != target:
+                    group = g
+                    break
+            else:
+                return None          # leaderless everywhere: retry
+        if target < 0:               # successor slot
+            lead = node.leader_of(group)
+            if lead < 0:
+                return None
+            target = (lead + 1) % self.cfg.num_peers
+        return group, target
+
+    def _drive_transfers(self, t: int) -> None:
+        from raftsql_tpu.runtime.node import TransferRefused
+        keep = []
+        for ev in self._xfer_todo:
+            if ev.tick > t:
+                keep.append(ev)
+                continue
+            if t - ev.tick > self.XFER_RETRY_TICKS:
+                self.report["transfer_drops"] += 1
+                continue
+            resolved = self._resolve_event(ev)
+            if resolved is None:
+                keep.append(ev)
+                continue
+            group, target = resolved
+            try:
+                self.node.transfer_leadership(
+                    group, target,
+                    deadline_ticks=self.plan.deadline_ticks)
+            except TransferRefused:
+                self.report["transfer_refusals"] += 1
+                keep.append(ev)
+                continue
+            self.report["transfers_requested"] += 1
+            self.avail.note_issued(t, group, ev.must_complete)
+        self._xfer_todo = keep
+
+    def _apply_faults(self, t: int, rng: np.random.Generator) -> None:
+        super()._apply_faults(t, rng)
+        self._drive_transfers(t)
+
+    # -- outcome absorption + serving probes ----------------------------
+
+    def _quiet(self, t0: int, t1: int) -> bool:
+        """No scheduled fault overlaps [t0, t1) — a probe armed here
+        has clean air to commit in."""
+        if t1 >= self.sched.ticks:
+            return False
+        for w in (self.sched.drops + self.sched.delays
+                  + self.sched.partitions + self.sched.asym_partitions
+                  + self.sched.skews):
+            if w.start < t1 and t0 < w.end:
+                return False
+        return all(not t0 <= ev.tick < t1 for ev in self.sched.crashes)
+
+    def _apply(self, g: int, idx: int, payload: bytes) -> None:
+        super()._apply(g, idx, payload)
+        parts = payload.decode("utf-8").split(" ")
+        if len(parts) == 3 and parts[0] == "SET":
+            self.avail.probe_committed(parts[2])
+
+    def _crash_restart(self, tick: int, power_loss: bool = False,
+                       tear_peer: int = -1) -> None:
+        # Latches and the outcome log die with the process: outstanding
+        # transfers are void, and the new node's event log starts empty.
+        self.avail.note_crash()
+        self._seen_events = 0
+        super()._crash_restart(tick, power_loss, tear_peer)
+
+    def _observe(self, t: int) -> None:
+        super()._observe(t)
+        events = list(self.node._xfer_events)
+        for e in events[self._seen_events:]:
+            self.avail.note_outcome(t, e["group"], e["outcome"],
+                                    e["stall_ticks"])
+            if e["outcome"] == "completed":
+                self.report["transfers_completed"] += 1
+            else:
+                self.report["transfers_aborted"] += 1
+            # Post-resolution serving probe: only in clean air — under
+            # an active fault window a slow commit is the fault's
+            # doing, not the transfer's.
+            g = e["group"]
+            if self._quiet(t, t + self.plan.probe_ticks + 1):
+                value = f"v{self._wseq}"
+                self._wseq += 1
+                self.lin.begin_write(f"k{g}", value)
+                self.node.propose_many(g, [f"SET k{g} {value}".encode()])
+                self.avail.arm_probe(t, g, value)
+                self.report["transfer_probes"] += 1
+        self._seen_events = len(events)
+        self.report["max_transfer_stall"] = self.avail.max_stall
+        self.report["transfer_probes_confirmed"] = \
+            self.avail.probes_confirmed
+        self.avail.check(t)
+        if t == self.sched.ticks - 1:
+            self.avail.final_check(t)
+
+    def _report(self) -> dict:
+        r = super()._report()
+        r["plan_digest"] = self.plan.digest()
+        return r
+
+
 def schedule_peers(schedule: ChaosSchedule) -> int:
     """Peer count implied by a schedule's targets (min 3)."""
     peers = 3

@@ -427,6 +427,162 @@ class ReadNemesisPlan:
         return hashlib.sha256(blob).hexdigest()[:16]
 
 
+# TransferEvent.target sentinel: resolved at issue time to the peer the
+# plan's FIRST partition window isolated (the "lagging" peer — behind by
+# a whole window of appends).  The directed falsification plan uses it
+# to aim a transfer at a provably-behind target.
+XFER_LAGGER = -3
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferEvent:
+    """Graceful leadership transfer requested at `tick` (retried each
+    tick until the engine accepts it or the retry budget runs out).
+    `group` -1 = any group currently led by someone other than the
+    resolved target; `target` -1 = the leader's successor slot
+    ((leader + 1) % peers), XFER_LAGGER = the first partition window's
+    isolated peer.  `must_complete` marks the directed falsification
+    probe: the transfer MUST end `completed` within the plan's
+    max_stall_ticks — the §3.10-broken kernel (unsafe_transfer) deposes
+    the old leader before the target caught up, the behind target can
+    never win the election, and the transfer ABORTS instead."""
+    tick: int
+    group: int = -1
+    target: int = -1
+    must_complete: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferNemesisPlan:
+    """Scripted transfer-under-nemesis attack (fused plane,
+    chaos/scenarios.py TransferChaosRunner): graceful leadership
+    transfers race the existing nemesis arsenal — drops, partitions
+    (leader-targeted kills), one-directional cuts, clock skew, and
+    crash+restart — under live acked-PUT load, checked by the
+    TransferAvailability invariant (bounded per-transfer proposal
+    stall, aborted transfers re-open the group) on top of the standing
+    election-safety / durability / linearizability invariants.
+
+    A SEPARATE plan class on purpose (ReadNemesisPlan precedent):
+    extending ChaosSchedule would change the asdict() digest of every
+    existing family.  The runner projects the fault fields into a
+    ChaosSchedule internally so fault application shares the proven
+    code paths.
+
+    `unsafe_transfer=True` compiles the deliberately broken transfer
+    kernel (config.py unsafe_transfer: no catch-up gate, instant
+    abdication) — the falsification plan the harness must CATCH."""
+    seed: int
+    ticks: int
+    peers: int = 3
+    groups: int = 4
+    transfers: Tuple[TransferEvent, ...] = ()
+    drops: Tuple[DropWindow, ...] = ()
+    partitions: Tuple[PartitionWindow, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    skews: Tuple[SkewWindow, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    election_ticks: int = 10
+    # Engine-side per-transfer deadline (device steps): past it the host
+    # clears the latch and the group resumes under the old leader.
+    deadline_ticks: int = 40
+    # Directed stall bound for must_complete transfers (falsification).
+    max_stall_ticks: int = 60
+    # A probe write proposed when a transfer resolves inside a
+    # fault-free window must commit within this many ticks (the
+    # "group keeps serving" leg of the availability invariant).
+    probe_ticks: int = 30
+    unsafe_transfer: bool = False
+    prop_rate: float = 0.7
+    read_rate: float = 0.25
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.describe(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def generate_transfers(seed: int, ticks: int = 260,
+                       peers: int = 3) -> TransferNemesisPlan:
+    """The transfer-under-nemesis family: graceful transfers land
+    before, inside, and after each fault window — a leader-targeted
+    partition (the fused plane's leader kill), a one-directional cut, a
+    drop window, a clock-skew window, and a whole-process crash — while
+    the acked-PUT workload keeps running.  At least two transfers fall
+    in fault-free air so their serving probes actually assert."""
+    rng = np.random.default_rng(seed ^ 0x7AFE)
+    warmup = 40
+    groups = 4
+    # Fault windows in the middle third-ish of the run.
+    p0 = int(rng.integers(warmup + 20, ticks // 3))
+    part = PartitionWindow(p0, p0 + int(rng.integers(20, 30)),
+                           LEADER_TARGET)
+    a0 = int(rng.integers(ticks // 3 + 10, ticks // 2))
+    asym = AsymPartitionWindow(a0, a0 + int(rng.integers(15, 25)),
+                               LEADER_TARGET, int(rng.integers(0, peers)))
+    d0 = int(rng.integers(ticks // 2, int(ticks * 0.62)))
+    drop = DropWindow(d0, d0 + int(rng.integers(15, 25)),
+                      float(rng.uniform(0.08, 0.2)))
+    incs = [1] * peers
+    incs[int(rng.integers(0, peers))] = 2
+    s0 = int(rng.integers(int(ticks * 0.62), int(ticks * 0.72)))
+    skew = SkewWindow(s0, s0 + int(rng.integers(12, 20)), tuple(incs))
+    crash = CrashEvent(int(rng.integers(int(ticks * 0.72),
+                                        int(ticks * 0.8))))
+    # Transfers: two in the clean warmup air, one inside each fault
+    # window (racing it), two in the post-crash tail.
+    evs = [
+        TransferEvent(warmup, int(rng.integers(0, groups))),
+        TransferEvent(warmup + 8, int(rng.integers(0, groups))),
+        TransferEvent(part.start + 5, int(rng.integers(0, groups))),
+        TransferEvent(asym.start + 4, int(rng.integers(0, groups))),
+        TransferEvent(drop.start + 4, int(rng.integers(0, groups))),
+        TransferEvent(skew.start + 3, int(rng.integers(0, groups))),
+        TransferEvent(crash.tick + 12, int(rng.integers(0, groups))),
+        TransferEvent(crash.tick + 24, int(rng.integers(0, groups))),
+    ]
+    return TransferNemesisPlan(
+        seed=seed, ticks=max(ticks, crash.tick + 70), peers=peers,
+        groups=groups, transfers=tuple(evs), drops=(drop,),
+        partitions=(part,), asym_partitions=(asym,), skews=(skew,),
+        crashes=(crash,))
+
+
+def falsification_transfer_plan(seed: int = 0,
+                                broken: bool = True
+                                ) -> TransferNemesisPlan:
+    """DIRECTED transfer-falsification scenario: a long leader-targeted
+    partition leaves one peer a full window of appends behind; after
+    the heal, a must_complete transfer aims a group at exactly that
+    lagging peer.  The CORRECT kernel (thesis §3.10) holds the
+    TimeoutNow until the target's match_index catches up, then the
+    target wins immediately — the transfer COMPLETES well inside
+    max_stall_ticks.  broken=True compiles the unsafe kernel (no
+    catch-up gate, instant abdication): the behind target calls an
+    election it cannot win (log restriction), the group goes leaderless
+    until a third peer times out, and the transfer ABORTS — the
+    TransferAvailability invariant MUST fire on the same schedule,
+    proving the harness detects the §3.10 mistake, not chaos in
+    general."""
+    # The transfer fires AT the heal tick: the lagger is still a full
+    # window of appends behind (replication closes the gap at
+    # max_entries_per_msg per tick, so waiting even a handful of ticks
+    # would hand the broken kernel an already-caught-up target and
+    # nothing to falsify).
+    part = PartitionWindow(40, 100, LEADER_TARGET)
+    xfer = TransferEvent(100, group=-1, target=XFER_LAGGER,
+                         must_complete=True)
+    return TransferNemesisPlan(
+        seed=seed, ticks=200, peers=3, groups=2,
+        transfers=(xfer,), partitions=(part,),
+        election_ticks=10, deadline_ticks=80, max_stall_ticks=60,
+        probe_ticks=40, unsafe_transfer=broken,
+        prop_rate=1.0, read_rate=0.2)
+
+
 def generate_reads(seed: int, ticks: int = 240,
                    peers: int = 3) -> ReadNemesisPlan:
     """The read-linearizability nemesis family: two skew windows at

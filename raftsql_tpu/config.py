@@ -35,6 +35,17 @@ MSG_RESP = 2
 # ride the same u8 wire field as MSG_REQ/MSG_RESP (transport/codec.py).
 MSG_PREREQ = 3
 MSG_PRERESP = 4
+# Leadership transfer (vote slot only): the raft thesis §3.10 TimeoutNow.
+# A transferring leader sends it to the caught-up target, which starts a
+# REAL election at term+1 immediately — skipping prevote entirely, which
+# is what lets the grant bypass the Phase-2b in-lease refusal for exactly
+# that target (every other peer still refuses in-lease probes).  Code >= 3
+# so Phase 1's term-adoption mask (REQ/RESP/rejected-PRERESP only) never
+# bumps terms off a stray TimeoutNow.
+MSG_TIMEONOW = 5
+
+# xfer_target sentinel: no leadership transfer pending for the group.
+NO_XFER = -1
 
 # Floor-reject resync marker: a follower that cannot verify an append
 # below its transition-table floor answers with
@@ -172,6 +183,17 @@ class RaftConfig:
     # False, log_term is kept as a [G, 1] stub so the state pytree keeps
     # its shape.
     keep_ring: bool = True
+
+    # FALSIFICATION ONLY (chaos/run.py transfer family): deliberately
+    # break leadership transfer by dropping the catch-up gate AND
+    # stepping the old leader down the instant the grant fires — the
+    # thesis-§3.10 mistake of deposing the leader before the target's
+    # log caught up.  The transfer availability invariant must CATCH
+    # this; the flag exists so the harness can prove it does.  Static
+    # w.r.t. jit like every other field: when False (always, outside
+    # the falsification leg) the compiled program is the shipping
+    # kernel, bit for bit.
+    unsafe_transfer: bool = False
 
     seed: int = 0
 

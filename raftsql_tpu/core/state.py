@@ -20,7 +20,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import FOLLOWER, NO_LEADER, NO_VOTE, RaftConfig
+from raftsql_tpu.config import (FOLLOWER, NO_LEADER, NO_VOTE, NO_XFER,
+                                RaftConfig)
 
 I32 = jnp.int32
 B = jnp.bool_
@@ -90,6 +91,19 @@ class PeerState(NamedTuple):
     # from zeros, so a rebooted leader holds no lease until a fresh
     # quorum round confirms it.
     resp_tick: jax.Array     # [G, P] i32
+
+    # Leadership-transfer latch (raft thesis §3.10, core/step.py transfer
+    # phase): peer slot this group's LEADER row is transferring to, or
+    # NO_XFER.  While set on a leader row the group stops accepting new
+    # proposals and re-sends MSG_TIMEONOW to the target each tick once
+    # its match has caught up; the row auto-clears the moment the row is
+    # no longer leader (deposed by the target's election — completion —
+    # or by anyone else).  The host patches it (set_transfer_target) and
+    # owns deadline/abort; with every row at NO_XFER the whole phase is
+    # gate-false and trajectories are bit-identical to the pre-transfer
+    # kernel.  Volatile across restart by design (init gives NO_XFER):
+    # a rebooted leader holds no transfer.
+    xfer_target: jax.Array   # [G] i32
 
     rng: jax.Array           # [2]/key PRNG state for election jitter
     tick: jax.Array          # [] i32 step counter (for PRNG folding)
@@ -161,6 +175,12 @@ class StepInfo(NamedTuple):
     # cfg.lease_ticks == 0).  The §6.4 current-term-commit
     # precondition is already folded in on device.
     lease: jax.Array         # i32 [G]
+    # Leadership-transfer latch AFTER the step (PeerState.xfer_target
+    # carry): the target while this row still leads and a transfer is
+    # armed, NO_XFER otherwise.  The host watches this column drop back
+    # to NO_XFER on the (former) leader row to detect completion — the
+    # device clears it the tick the row is deposed.
+    xfer: jax.Array          # i32 [G]
     # Leader view [G, P]: where each peer's replication stands.  The host
     # uses this to spot followers that have fallen out of the device term
     # ring (next_idx <= log_len - W) OR below the transition-table floor
@@ -218,6 +238,7 @@ def init_peer_state(cfg: RaftConfig, self_id: int | jax.Array,
         voters=voters,
         voters_joint=voters_joint,
         resp_tick=jnp.zeros((g, p), I32),
+        xfer_target=jnp.full((g,), NO_XFER, I32),
         rng=key,
         tick=jnp.zeros((), I32),
     )
@@ -366,6 +387,33 @@ def set_group_config_stacked(states: PeerState, p: jax.Array,
 
 
 @functools.partial(jax.jit, donate_argnums=0)
+def set_transfer_target(state: PeerState, g: jax.Array,
+                        target: jax.Array) -> PeerState:
+    """Arm (target >= 0) or clear (NO_XFER) group `g`'s leadership
+    transfer on this peer's row.  Host-plane admin patch, same contract
+    as set_group_config: the step only READS xfer_target; arming a
+    non-leader row is harmless (the step clears it next tick), and the
+    abort path clears it to cleanly re-open the group for proposals."""
+    g = jnp.asarray(g, I32)
+    return state._replace(
+        xfer_target=state.xfer_target.at[g].set(jnp.asarray(target, I32)))
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def set_transfer_target_stacked(states: PeerState, p: jax.Array,
+                                g: jax.Array,
+                                target: jax.Array) -> PeerState:
+    """`set_transfer_target` over a STACKED cluster state (leaves
+    [P, G, ...], runtime/fused.py / runtime/mesh.py): arm or clear peer
+    row `p`'s transfer latch for group `g`."""
+    p = jnp.asarray(p, I32)
+    g = jnp.asarray(g, I32)
+    return states._replace(
+        xfer_target=states.xfer_target.at[p, g].set(
+            jnp.asarray(target, I32)))
+
+
+@functools.partial(jax.jit, donate_argnums=0)
 def install_snapshot_state(state: PeerState, g: jax.Array,
                            last_idx: jax.Array, last_term: jax.Array,
                            sender_term: jax.Array) -> PeerState:
@@ -413,6 +461,7 @@ def install_snapshot_state(state: PeerState, g: jax.Array,
         next_idx=state.next_idx.at[g].set(last_idx + 1),
         elapsed=state.elapsed.at[g].set(0),
         resp_tick=state.resp_tick.at[g].set(0),
+        xfer_target=state.xfer_target.at[g].set(NO_XFER),
     )
 
 

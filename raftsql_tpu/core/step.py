@@ -35,8 +35,8 @@ import jax.numpy as jnp
 
 from raftsql_tpu.config import (CANDIDATE, FLOOR_HINT_BIAS, FOLLOWER, LEADER,
                                 MSG_NONE, MSG_PREREQ, MSG_PRERESP, MSG_REQ,
-                                MSG_RESP, NO_LEADER, NO_VOTE, PRECANDIDATE,
-                                RaftConfig)
+                                MSG_RESP, MSG_TIMEONOW, NO_LEADER, NO_VOTE,
+                                NO_XFER, PRECANDIDATE, RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
                                     tbl_floor, term_at_tbl)
 from raftsql_tpu.ops import dense
@@ -157,6 +157,27 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     leader_hint = jnp.where(bumped, NO_LEADER, state.leader_hint)
 
     my_last_term = term_of0(log_len)                              # [G]
+
+    # ---- Phase 1b: TimeoutNow receipt (leadership transfer, raft thesis
+    # §3.10).  A caught-up transfer target starts a REAL election at
+    # term+1 immediately — no prevote probe, which is exactly how the
+    # grant bypasses the Phase-2b in-lease refusal for this one peer
+    # (every other peer keeps refusing in-lease probes, so nobody else
+    # can race the handoff inside the lease).  Gated on the sender's
+    # CURRENT term (a stale grant from a deposed leader is ignored) and
+    # on self being a voter (learners/spares never campaign, Phase 8).
+    # With no transfer armed anywhere (xfer_target all NO_XFER — the
+    # shipping default) no MSG_TIMEONOW ever exists and this phase is a
+    # no-op: trajectories stay bit-identical to the pre-transfer kernel.
+    tnow_fire = ((inbox.v_type == MSG_TIMEONOW)
+                 & (inbox.v_term == term[:, None])).any(-1) \
+        & (role != LEADER) & self_voter
+    term = jnp.where(tnow_fire, term + 1, term)
+    role = jnp.where(tnow_fire, CANDIDATE, role)
+    voted = jnp.where(tnow_fire, self_id, voted)
+    votes = jnp.where(tnow_fire[:, None],
+                      jnp.broadcast_to(self_onehot, (G, P)), votes)
+    leader_hint = jnp.where(tnow_fire, NO_LEADER, leader_hint)
 
     # ---- Phase 2: RequestVote requests.  Grant at most one vote per group
     # per tick (voted_for is single-valued); re-granting to the same
@@ -379,13 +400,21 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
 
     # ---- Phase 6: proposals (+ the new-leader no-op entry).
     is_leader = role == LEADER
+    # Leadership transfer in flight (thesis §3.10 step 1): the group
+    # stops accepting NEW proposals so the target's match can converge
+    # on a fixed log tip.  Queued proposals stay queued on the host and
+    # drain to the new leader (or to us again, after a host abort clears
+    # the latch) — never dropped.  All-NO_XFER (the default) makes this
+    # mask all-False and n_acc bit-identical to the untransferred kernel.
+    transferring = is_leader & (state.xfer_target != NO_XFER)
+    n_acc = jnp.where(transferring, 0, prop_n)
     # Flow control: never let uncommitted depth overrun the term ring.  The
     # no-op consumes space too — a flapping leadership under a stalled
     # commit must not grow the log unboundedly.
     space = jnp.maximum(W - 2 * E - (log_len - commit), 0)
     noop_n = (become_leader & (space >= 1)).astype(I32)
     n_acc = jnp.where(is_leader,
-                      jnp.minimum(jnp.minimum(prop_n, E), space - noop_n), 0)
+                      jnp.minimum(jnp.minimum(n_acc, E), space - noop_n), 0)
     total_app = noop_n + n_acc
     prop_base = log_len + noop_n
     # Appended entries all carry the leader's current term, so this ring
@@ -454,8 +483,11 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
             voters=voters, voters_joint=jvoters, window=W,
             term_of=term_of1)
 
-    # ---- Phase 8: timers and election start.
-    reset = any_grant | any_app
+    # ---- Phase 8: timers and election start.  tnow_fire counts as a
+    # reset: the transfer target just started a REAL election (Phase 1b)
+    # and must not immediately re-fire as a PRECANDIDATE on a stale
+    # elapsed counter, which would demote the in-flight candidacy.
+    reset = any_grant | any_app | tnow_fire
     elapsed = jnp.where(is_leader | reset, 0, state.elapsed + timer_inc)
     # Learners/spares (self outside both masks) never campaign: their
     # timers tick but cannot fire — they follow whoever the voters
@@ -579,6 +611,37 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     o_v_last_term = jnp.broadcast_to(my_last_term2[:, None], (G, P))
     o_v_granted = (grant | pre_grant) & ~cand_bcast
 
+    # Leadership transfer, leader side (thesis §3.10 steps 2-3): while a
+    # transfer is armed, fire MSG_TIMEONOW at the target once its MATCH
+    # covers our whole log — re-sent every tick while the latch holds,
+    # so a lost grant costs a tick, not the transfer.  The target must
+    # be a real peer and a voter under the ACTIVE configuration (either
+    # mask during a joint change — the same eligibility the vote-grant
+    # gate enforces, so an electable target is never refused and a
+    # learner/spare never granted).  The write tops the vote-slot
+    # priority chain for that one dst; a clobbered response re-sends
+    # next tick (raft tolerates loss).  All-NO_XFER keeps every gate
+    # here false.
+    xfer = state.xfer_target                                      # [G]
+    tgt_clip = jnp.clip(xfer, 0, P - 1)
+    tgt_is_voter = dense.pick_peer(
+        (voters | jvoters).astype(I32), tgt_clip) > 0             # [G]
+    tgt_caught = dense.pick_peer(match, tgt_clip) >= log_len      # [G]
+    send_tnow = transferring & (xfer >= 0) & (xfer < P) \
+        & (xfer != self_id) & tgt_is_voter
+    if not cfg.unsafe_transfer:
+        send_tnow = send_tnow & tgt_caught
+    tnow_dst = send_tnow[:, None] & (src_ids == tgt_clip[:, None])  # [G, P]
+    o_v_type = jnp.where(tnow_dst, MSG_TIMEONOW, o_v_type)
+    o_v_term = jnp.where(tnow_dst, term[:, None], o_v_term)
+    o_v_granted = o_v_granted & ~tnow_dst
+    if cfg.unsafe_transfer:
+        # FALSIFICATION ONLY (config.py unsafe_transfer): fire without
+        # the catch-up gate and abdicate the instant the grant goes out
+        # — the §3.10 mistake the transfer chaos family must catch.
+        role = jnp.where(send_tnow, FOLLOWER, role)
+        leader_hint = jnp.where(send_tnow, NO_LEADER, leader_hint)
+
     # Append responses (to every append request seen, incl. stale-term ones
     # so old leaders step down).
     chosen_mask = areq_cur & (src_ids == asrc[:, None]) & any_app[:, None]
@@ -676,6 +739,14 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         a_prev_term=o_a_prev_term, a_n=o_a_n, a_ents=o_a_ents,
         a_commit=o_a_commit, a_success=o_a_success, a_match=o_a_match)
 
+    # Transfer latch carry: held only while this row still LEADS the
+    # group.  Deposition — by the target's term+1 election (completion),
+    # by any other election, or by the unsafe-variant abdication — clears
+    # it on device, which is also the host's completion signal (the
+    # "xfer" info column below drops to NO_XFER).  A latch armed on a
+    # non-leader row (host race with an election) clears the same way.
+    xfer = jnp.where(role == LEADER, xfer, NO_XFER)
+
     new_state = PeerState(
         term=term, voted_for=voted, role=role, leader_hint=leader_hint,
         commit=commit, log_len=log_len, log_term=log_term,
@@ -683,7 +754,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         elapsed=elapsed, timeout=timeout, hb_elapsed=hb,
         votes=votes, match=match, next_idx=next_idx,
         voters=voters, voters_joint=jvoters,
-        resp_tick=resp_tick,
+        resp_tick=resp_tick, xfer_target=xfer,
         rng=state.rng, tick=state.tick + 1)
 
     # Ticks until any timer could fire with no further input: non-leader
@@ -707,6 +778,7 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         app_conflict=conflict,
         new_log_len=log_len,
         lease=lease_until,
+        xfer=xfer,
         next_idx=next_idx,
         floor=floor1,
         timer_margin=timer_margin)
@@ -744,7 +816,7 @@ IB_NCOLS = len(MSG_FIELDS)
 INFO_FIELDS = ("commit", "role", "term", "voted_for", "leader_hint",
                "prop_base", "prop_accepted", "noop", "app_from",
                "app_start", "app_n", "app_conflict", "new_log_len",
-               "floor", "lease")
+               "floor", "lease", "xfer")
 INFO_NCOLS = len(INFO_FIELDS)
 
 

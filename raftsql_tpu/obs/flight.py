@@ -32,7 +32,7 @@ class FlightRecorder:
 
     def dump(self, name: str, reason: str, tracer=None, ring=None,
              meta: Optional[dict] = None, node=None,
-             ring_server=None) -> Optional[str]:
+             ring_server=None, placement=None) -> Optional[str]:
         """Write flight-<name>.json; returns the path, or None if the
         write failed (never raises — the invariant error must win).
 
@@ -40,9 +40,13 @@ class FlightRecorder:
         post-PR-7 stack crashes with: the double-buffered overlap
         stash's status at crash time (was a durable phase in flight,
         and for which tick?), the WAL group-commit batch histogram,
-        and the tick-phase profile.  `ring_server` (runtime/ring.py
-        RingServer) adds per-worker propose/completion ring cursors
-        and depths."""
+        and the tick-phase profile — plus the transfer plane's
+        in-flight latches and recent outcomes (PR 11).  `ring_server`
+        (runtime/ring.py RingServer) adds per-worker propose/completion
+        ring cursors and depths.  `placement` (a PlacementController)
+        attaches the controller's recent decision log (group, from, to,
+        outcome, stall ticks), so a failed transfer invariant is
+        attributable to the decision that caused it."""
         doc = {
             "reason": reason,
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -61,6 +65,8 @@ class FlightRecorder:
             if ring_server is not None:
                 doc.setdefault("serving", {})["rings"] = \
                     ring_server.flight_doc()
+            if placement is not None:
+                doc["placement"] = placement.doc()
         except Exception as e:      # noqa: BLE001 - diagnostics only
             doc["collect_error"] = repr(e)
         path = os.path.join(self.directory, f"flight-{name}.json")
@@ -105,6 +111,11 @@ class FlightRecorder:
             out["phase_profile"] = prof.snapshot()
         traffic = getattr(node, "traffic", None)
         if traffic is not None:
+            xg = getattr(node, "transferring_groups", None)
             out["group_traffic"] = traffic.doc(
-                leader_of=getattr(node, "leader_of", None))
+                leader_of=getattr(node, "leader_of", None),
+                transferring=xg() if callable(xg) else None)
+        xfers = getattr(node, "transfers_doc", None)
+        if callable(xfers):
+            out["transfers"] = xfers()
         return out

@@ -82,7 +82,7 @@ def state_specs() -> PeerState:
         elapsed=s2, timeout=s2, hb_elapsed=s2,
         votes=s3, match=s3, next_idx=s3,
         voters=s3, voters_joint=s3,
-        resp_tick=s3,
+        resp_tick=s3, xfer_target=s2,
         rng=P(PEERS_AXIS), tick=P(PEERS_AXIS))
 
 
@@ -101,7 +101,7 @@ def info_specs() -> StepInfo:
         commit=s2, role=s2, term=s2, voted_for=s2, leader_hint=s2,
         prop_base=s2, prop_accepted=s2, noop=s2,
         app_from=s2, app_start=s2, app_n=s2, app_conflict=s2,
-        new_log_len=s2, lease=s2,
+        new_log_len=s2, lease=s2, xfer=s2,
         next_idx=P(PEERS_AXIS, GROUPS_AXIS, None),
         floor=s2, timer_margin=P(PEERS_AXIS))
 

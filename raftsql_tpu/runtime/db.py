@@ -243,6 +243,10 @@ class RaftDB:
         # callable whose dict is merged into metrics() — ring depth,
         # proposed/completed counts of the multi-worker deployment.
         self.serving_metrics = None
+        # Placement controller (raftsql_tpu/placement/), attached by
+        # the server's --placement flag; None keeps metrics() and
+        # flight bundles unchanged.
+        self.placement = None
         # propose→commit (stamped when the committed entry reaches the
         # apply consumer — commit + publish, before apply): the
         # histogram /metrics exports as propose_commit_p50/p95/p99_ms.
@@ -665,9 +669,15 @@ class RaftDB:
             m["phase_profile"] = prof.snapshot()
         traffic = getattr(node, "traffic", None)
         if traffic is not None:
+            xg = getattr(node, "transferring_groups", None)
             m["group_traffic"] = traffic.doc(
                 leader_of=getattr(node, "leader_of", None),
-                shard_of=getattr(node, "_group_shard_of", None))
+                shard_of=getattr(node, "_group_shard_of", None),
+                transferring=xg() if callable(xg) else None)
+        # Placement controller (raftsql_tpu/placement/): balance gauges
+        # + issue counters, when a controller is attached.
+        if self.placement is not None:
+            m["placement"] = self.placement.metrics_doc()
         gcw = getattr(node, "_gcwal", None)
         if gcw is not None:
             # Group-commit batch histogram: peers coalesced per fsync
@@ -713,6 +723,24 @@ class RaftDB:
             raise ValueError("engine has no membership plane")
         try:
             return fn(group, op, peer)
+        except NotLeaderForChange as e:
+            raise NotLeaderError(e.group, e.leader) from e
+
+    def transfer(self, group: int, target: int) -> dict:
+        """POST /transfer: arm a graceful leadership transfer of
+        `group` to peer slot `target` (0-based, like /members' `peer`;
+        thesis §3.10 TimeoutNow — the device plane stalls intake, waits
+        for catch-up, fires the grant).  Not-leader maps onto
+        NotLeaderError so both HTTP planes answer 421 + the hint;
+        validation refusals (in-flight transfer, learner target)
+        surface as 400s."""
+        from raftsql_tpu.membership import NotLeaderForChange
+        node = self.pipe.node
+        fn = getattr(node, "transfer_leadership", None)
+        if fn is None:
+            raise ValueError("engine has no leadership-transfer plane")
+        try:
+            return fn(group, target)
         except NotLeaderForChange as e:
             raise NotLeaderError(e.group, e.leader) from e
 

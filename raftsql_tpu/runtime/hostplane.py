@@ -57,16 +57,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.config import NO_XFER, RaftConfig
 from raftsql_tpu.core.cluster import (empty_cluster_inbox,
                                       init_cluster_state)
 from raftsql_tpu.core.state import (restore_peer_state,
-                                    set_group_config_stacked)
+                                    set_group_config_stacked,
+                                    set_transfer_target_stacked)
 from raftsql_tpu.core.step import INFO_FIELDS
 from raftsql_tpu.transport.codec import (CONF_PREFIX as _CONF_PREFIX,
                                          decode_conf_entry,
                                          is_conf_entry)
-from raftsql_tpu.runtime.node import CLOSED, RAW_MANY, RAW_PLAIN
+from raftsql_tpu.runtime.node import (CLOSED, RAW_MANY, RAW_PLAIN,
+                                      TransferRefused)
 from raftsql_tpu.native.build import load_native_plog
 from raftsql_tpu.storage import fsio
 from raftsql_tpu.storage.log import NativePayloadLog, PayloadLog
@@ -249,6 +251,17 @@ class ClusterHostPlane:
         # enable_membership(): None keeps the static tick byte-identical
         # (every hook gates on one attribute test).
         self.membership = None
+        # Leadership-transfer plane (thesis §3.10, PR 11): one latch
+        # per group.  Client threads VALIDATE and enqueue into
+        # _xfer_req; the tick thread arms the device latch (self.states
+        # is donated every dispatch) and drives completion/abort in
+        # _transfer_advance.  _xfer_events is the recent-outcome log
+        # flight bundles attach for attribution.
+        from collections import deque as _deque
+        self._xfer_lock = threading.Lock()
+        self._xfer_req: List[Tuple[int, int, int]] = []
+        self._xfers: Dict[int, dict] = {}
+        self._xfer_events = _deque(maxlen=256)
         self._conf_pending: List[list] = []      # per group [(idx, data)]
         self._conf_scrub: List[set] = []         # per group conf indexes
         self._conf_cursor: Optional[np.ndarray] = None   # [P, G]
@@ -689,6 +702,121 @@ class ClusterHostPlane:
         self.propose_many(group, [entry])
         return self.membership.describe(group)
 
+    # -- leadership transfer (raft thesis §3.10, PR 11) -----------------
+
+    def transfer_leadership(self, group: int, target: int,
+                            deadline_ticks: Optional[int] = None) -> dict:
+        """Arm a graceful leadership transfer of `group` to peer slot
+        `target` (0-based).  The device latch stops proposal intake for
+        the group, waits for the target's match_index to catch up, then
+        fires the TimeoutNow grant (core/step.py Phase 9); queued
+        proposals re-route to the new leader automatically once the
+        hint moves.  One in flight per group; past `deadline_ticks` of
+        device steps (default 4 election timeouts) the host clears the
+        latch and the group resumes serving under the old leader.
+        Client-thread safe — the tick thread patches device state."""
+        cfg = self.cfg
+        if not 0 <= group < cfg.num_groups:
+            raise ValueError(f"group {group} out of range")
+        if not 0 <= target < cfg.num_peers:
+            raise ValueError(f"target {target} out of peer-slot range")
+        lead = int(self._hints[group])
+        if lead < 0:
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(group, "group has no leader yet")
+        if target == lead:
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(group, "target already leads")
+        if self.membership is not None \
+                and not self.membership.is_voter(group, target):
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(
+                group, f"peer {target} is a learner/non-voter")
+        dl = int(deadline_ticks) if deadline_ticks \
+            else 4 * cfg.election_ticks
+        with self._xfer_lock:
+            if group in self._xfers:
+                self.metrics.transfers_refused += 1
+                raise TransferRefused(group, "transfer already in flight")
+            self._xfers[group] = {"target": target, "from": lead,
+                                  "start_tick": self._tick_no,
+                                  "deadline_ticks": dl, "deadline": None,
+                                  "armed": False}
+            self._xfer_req.append((lead, group, target))
+        self.metrics.transfers_initiated += 1
+        self._work_evt.set()          # wake a parked tick loop
+        return {"group": group, "from": lead + 1, "target": target + 1,
+                "deadline_ticks": dl}
+
+    def _transfer_arm(self) -> None:
+        """Apply queued transfer requests to device state (tick thread,
+        before the dispatch so this tick's step sees the latch)."""
+        with self._xfer_lock:
+            reqs, self._xfer_req = self._xfer_req, []
+            for (p, g, tgt) in reqs:
+                self.states = set_transfer_target_stacked(
+                    self.states, p, g, tgt)
+                tr = self._xfers.get(g)
+                if tr is not None:
+                    tr["armed"] = True
+                    tr["deadline"] = (self._device_steps
+                                      + tr["deadline_ticks"])
+
+    def _transfer_advance(self, pinfo: np.ndarray) -> None:
+        """Completion/abort driver (tick thread, right after the hint
+        refresh).  Completed: the hint names the target.  Aborted: the
+        deadline passed, or leadership settled on a third peer — either
+        way the latch is cleared so the group keeps serving."""
+        xcol = pinfo[:, :, _C["xfer"]]
+        now = self._device_steps
+        with self._xfer_lock:
+            for g, tr in list(self._xfers.items()):
+                if not tr["armed"]:
+                    continue
+                outcome = None
+                h = int(self._hints[g])
+                frm = tr["from"]
+                armed_dev = int(xcol[frm, g]) == tr["target"]
+                if h == tr["target"]:
+                    outcome = "completed"
+                elif now >= tr["deadline"]:
+                    if armed_dev:
+                        self.states = set_transfer_target_stacked(
+                            self.states, frm, g, NO_XFER)
+                    outcome = "aborted"
+                elif not armed_dev and 0 <= h != frm:
+                    outcome = "aborted"    # settled elsewhere
+                if outcome is None:
+                    continue
+                del self._xfers[g]
+                stall = self._tick_no - tr["start_tick"]
+                if outcome == "completed":
+                    self.metrics.transfers_completed += 1
+                else:
+                    self.metrics.transfers_aborted += 1
+                self.metrics.note_transfer_stall(stall)
+                self._xfer_events.append(
+                    {"group": g, "from": frm + 1,
+                     "to": tr["target"] + 1, "outcome": outcome,
+                     "stall_ticks": int(stall), "tick": self._tick_no})
+
+    def transferring_groups(self) -> set:
+        """Groups with a transfer in flight (hot-groups `transferring`
+        flag)."""
+        with self._xfer_lock:
+            return set(self._xfers)
+
+    def transfers_doc(self) -> dict:
+        """In-flight latches + the recent-outcome log (flight bundles,
+        placement-controller feedback)."""
+        with self._xfer_lock:
+            inflight = {str(g): {"target": tr["target"] + 1,
+                                 "from": tr["from"] + 1,
+                                 "start_tick": tr["start_tick"]}
+                        for g, tr in self._xfers.items()}
+            recent = list(self._xfer_events)
+        return {"in_flight": inflight, "recent": recent}
+
     def propose_many(self, group: int, payloads) -> None:
         """Queue payloads at the group's current leader peer (host-side
         routing — all peers share this process; the distributed
@@ -974,6 +1102,8 @@ class ClusterHostPlane:
         prof = self.prof
         prof_on = prof is not None and prof.sampled(self._tick_no)
         t0 = _t.monotonic()
+        if self._xfer_req:
+            self._transfer_arm()     # latch visible to THIS dispatch
         # Snapshot _queued: _build_prop_n may re-route into the set.
         prop_n = self._build_prop_n(self._steps)
         tb = _t.monotonic() if prof_on else t0
@@ -1060,6 +1190,8 @@ class ClusterHostPlane:
         self._hints = pinfo[0, :, _C["leader_hint"]]
         self._lease_col = pinfo[:, :, _C["lease"]]
         self._device_steps += len(step_infos)
+        if self._xfers:
+            self._transfer_advance(pinfo)
         # Stage the 2a ranges NOW (this pops the device-accepted
         # proposals off the queues): whether the durable phase runs
         # inline below or stashed into the next dispatch window, the
@@ -1091,7 +1223,8 @@ class ClusterHostPlane:
         base_active = (tick_active
                        or dev_busy
                        or bool((self._hints < 0).any())
-                       or bool(self._queued))
+                       or bool(self._queued)
+                       or bool(self._xfers))
         # HOT means real client work is flowing (writes this tick, a
         # device dispatch still in flight, or a proposal backlog): the
         # threaded loop then ticks back-to-back.  Merely-leaderless

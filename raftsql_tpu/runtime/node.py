@@ -40,11 +40,12 @@ import jax
 import jax.numpy as jnp
 
 from raftsql_tpu.config import (CANDIDATE, FOLLOWER, LEADER, MSG_REQ,
-                                MSG_RESP, NO_VOTE, PRECANDIDATE,
+                                MSG_RESP, NO_VOTE, NO_XFER, PRECANDIDATE,
                                 RaftConfig)
 from raftsql_tpu.core.state import (install_snapshot_state,
                                     restore_peer_state, set_group_config,
-                                    set_peer_progress)
+                                    set_peer_progress,
+                                    set_transfer_target)
 from raftsql_tpu.membership import (MembershipLagError, MembershipManager,
                                     NotLeaderForChange)
 from raftsql_tpu.transport.codec import CONF_PREFIX as _CONF_PREFIX, \
@@ -72,6 +73,21 @@ CLOSED = object()
 # Role-code → wire name map for GET /healthz (status()).
 _ROLE_NAMES = {FOLLOWER: "follower", CANDIDATE: "candidate",
                LEADER: "leader", PRECANDIDATE: "precandidate"}
+
+
+class TransferRefused(ValueError):
+    """A leadership-transfer request failed validation and was never
+    armed: a transfer is already in flight for the group, the target
+    already leads, or the target is a learner/non-voter (thesis §3.10
+    requires a VOTER target — a learner can never win the election the
+    TimeoutNow grant starts).  Subclasses ValueError so the HTTP planes
+    answer 400 without a dedicated handler; not-leader refusals raise
+    NotLeaderForChange instead (421 + retry hint)."""
+
+    def __init__(self, group: int, why: str):
+        super().__init__(f"group {group}: transfer refused: {why}")
+        self.group = group
+        self.why = why
 
 class _PackedView:
     """Attribute access over columns of a packed numpy array — the
@@ -214,6 +230,19 @@ class RaftNode:
         # requeue-retry safe).  Tick-thread only, no lock.
         self._local: List[List[Tuple[int, bytes]]] = [[] for _ in range(G)]
         self._tick_no = 0
+
+        # Leadership-transfer plane (thesis §3.10, PR 11): one latch per
+        # group, armed on the TICK thread (self.state is donated every
+        # step; client threads enqueue into _xfer_req instead of
+        # patching device state directly).  Deadlines run on the LEASE
+        # clock — the same timer units election timeouts count in — so
+        # an idle event loop's elided steps cannot stretch a transfer's
+        # abort horizon.  _xfer_events is the recent-outcome log flight
+        # bundles attach for attribution.
+        self._xfer_lock = threading.Lock()
+        self._xfer_req: List[Tuple[int, int]] = []
+        self._xfer: Dict[int, dict] = {}
+        self._xfer_events: deque = deque(maxlen=256)
 
         self.payload_log = PayloadLog(G)
         # [G] applied index and [G, 3] (term, voted_for, commit) hard-state
@@ -546,6 +575,119 @@ class RaftNode:
                                        4 * self.cfg.election_ticks)
                 if entry is not None:
                     self.propose_conf(g, entry)
+
+    # ------------------------------------------------------------------
+    # leadership transfer (raft thesis §3.10, PR 11)
+
+    def transfer_leadership(self, group: int, target: int,
+                            deadline_ticks: Optional[int] = None) -> dict:
+        """Arm a graceful leadership transfer of `group` to peer slot
+        `target` (0-based).  Accepted at the group's leader only; the
+        device latch stops proposal intake, waits for the target's
+        match_index to catch up, then fires the TimeoutNow grant
+        (core/step.py Phase 9).  One transfer in flight per group; the
+        host aborts and re-opens intake after `deadline_ticks` of lease
+        clock (default 4 election timeouts).  Client-thread safe: the
+        latch is armed by the tick thread."""
+        cfg = self.cfg
+        if not 0 <= group < cfg.num_groups:
+            raise ValueError(f"group {group} out of range")
+        if not 0 <= target < cfg.num_peers:
+            raise ValueError(f"target {target} out of peer-slot range")
+        if self._last_role[group] != LEADER:
+            self.metrics.transfers_refused += 1
+            raise NotLeaderForChange(group, self.leader_of(group) + 1)
+        if target == self.self_id:
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(group, "target already leads")
+        if self.membership is not None \
+                and not self.membership.is_voter(group, target):
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(
+                group, f"peer {target} is a learner/non-voter")
+        dl = int(deadline_ticks) if deadline_ticks \
+            else 4 * cfg.election_ticks
+        with self._xfer_lock:
+            if group in self._xfer:
+                self.metrics.transfers_refused += 1
+                raise TransferRefused(group, "transfer already in flight")
+            self._xfer[group] = {"target": target, "from": self.self_id,
+                                 "start_tick": self._tick_no,
+                                 "deadline_ticks": dl, "deadline": None,
+                                 "armed": False}
+            self._xfer_req.append((group, target))
+        self.metrics.transfers_initiated += 1
+        self._work_evt.set()
+        return {"group": group, "from": self.node_id,
+                "target": target + 1, "deadline_ticks": dl}
+
+    def _transfer_tick(self, info) -> None:
+        """Per-tick transfer driver (tick thread): arm queued requests
+        into device state, detect completion (we were deposed and the
+        hint names the target), and abort past-deadline transfers by
+        clearing the latch — which re-opens the group for proposals on
+        the very next step."""
+        if not (self._xfer or self._xfer_req):
+            return
+        with self._xfer_lock:
+            reqs, self._xfer_req = self._xfer_req, []
+            for (g, tgt) in reqs:
+                self.state = set_transfer_target(self.state, g, tgt)
+                tr = self._xfer.get(g)
+                if tr is not None:
+                    tr["armed"] = True
+                    tr["deadline"] = (self._lease_clock
+                                      + tr["deadline_ticks"])
+            role = info.role
+            hint = info.leader_hint
+            for g, tr in list(self._xfer.items()):
+                if not tr["armed"]:
+                    continue
+                outcome = None
+                h = int(hint[g])
+                if role[g] != LEADER and h == tr["target"]:
+                    outcome = "completed"
+                elif self._lease_clock >= tr["deadline"]:
+                    # Deadline: leadership never settled on the target.
+                    # If we still lead, drop the latch so intake
+                    # re-opens; if we were deposed elsewhere the latch
+                    # already self-cleared.
+                    if role[g] == LEADER:
+                        self.state = set_transfer_target(
+                            self.state, g, NO_XFER)
+                    outcome = "aborted"
+                elif role[g] != LEADER and 0 <= h != tr["target"]:
+                    outcome = "aborted"    # someone else won
+                if outcome is None:
+                    continue
+                del self._xfer[g]
+                stall = self._tick_no - tr["start_tick"]
+                if outcome == "completed":
+                    self.metrics.transfers_completed += 1
+                else:
+                    self.metrics.transfers_aborted += 1
+                self.metrics.note_transfer_stall(stall)
+                self._xfer_events.append(
+                    {"group": g, "from": tr["from"] + 1,
+                     "to": tr["target"] + 1, "outcome": outcome,
+                     "stall_ticks": int(stall), "tick": self._tick_no})
+
+    def transferring_groups(self) -> set:
+        """Groups with a leadership transfer in flight (hot-groups
+        `transferring` flag)."""
+        with self._xfer_lock:
+            return set(self._xfer)
+
+    def transfers_doc(self) -> dict:
+        """In-flight latches + the recent-outcome log (flight bundles,
+        `GET /metrics` debugging)."""
+        with self._xfer_lock:
+            inflight = {str(g): {"target": tr["target"] + 1,
+                                 "from": tr["from"] + 1,
+                                 "start_tick": tr["start_tick"]}
+                        for g, tr in self._xfer.items()}
+            recent = list(self._xfer_events)
+        return {"in_flight": inflight, "recent": recent}
 
     def leader_of(self, group: int) -> int:
         """Last known leader (0-based peer), -1 if unknown.
@@ -992,6 +1134,7 @@ class RaftNode:
         t3 = time.monotonic()
         self._publish_phase(info)       # … before published.
         self._membership_tick(info)     # joint-transition driver
+        self._transfer_tick(info)       # leadership-transfer driver
         t4 = time.monotonic()
         m.t_device_ms += (t1 - t0) * 1e3
         m.t_wal_ms += (t2 - t1) * 1e3

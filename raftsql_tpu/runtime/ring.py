@@ -47,6 +47,7 @@ nothing else parses these):
                                          token = session watermark)
       op 3 DOC      body = document name (metrics/health/members/...)
       op 4 MEMBER   body = json {group, op, peer}
+      op 5 XFER     body = json {group, target} (leadership transfer)
   completion: u64 req_id | u8 status | u32 leader | bytes body
       status 0 OK   (body = rows/doc for GET/DOC/MEMBER, empty for PUT;
                      leader = the engine's session watermark for the
@@ -73,7 +74,7 @@ _WRAP = 0xFFFFFFFF
 _REQ = struct.Struct("<BQIBQ")        # op, req_id, group, flags, token
 _CPL = struct.Struct("<QBI")          # req_id, status, leader
 
-OP_PUT, OP_GET, OP_DOC, OP_MEMBER = 1, 2, 3, 4
+OP_PUT, OP_GET, OP_DOC, OP_MEMBER, OP_XFER = 1, 2, 3, 4, 5
 ST_OK, ST_ERR, ST_NOT_LEADER, ST_UNAVAILABLE = 0, 1, 2, 3
 
 DEFAULT_RING_BYTES = 4 << 20
@@ -573,6 +574,28 @@ class RingServer:
 
         self._read_pool.submit(_run)
 
+    def _handle_transfer(self, worker: int, req_id: int,
+                         body: bytes) -> None:
+        from raftsql_tpu.runtime.db import NotLeaderError
+
+        def _run():
+            try:
+                req = json.loads(body.decode("utf-8") or "{}")
+                got = self.rdb.transfer(int(req.get("group", 0)),
+                                        int(req.get("target", -1)))
+            except NotLeaderError as e:
+                self._complete(worker, req_id, ST_NOT_LEADER,
+                               max(e.leader, 0), self._err_body(e))
+            except Exception as e:                      # noqa: BLE001
+                self._complete(worker, req_id, ST_ERR, 0,
+                               self._err_body(e))
+            else:
+                self._complete(worker, req_id, ST_OK, 0,
+                               (json.dumps(got, sort_keys=True) + "\n")
+                               .encode("utf-8"))
+
+        self._read_pool.submit(_run)
+
     # -- the drain loop --------------------------------------------------
 
     def _drain(self, worker: int) -> None:
@@ -600,6 +623,8 @@ class RingServer:
                         self._handle_doc(worker, req_id, body)
                     elif op == OP_MEMBER:
                         self._handle_member(worker, req_id, body)
+                    elif op == OP_XFER:
+                        self._handle_transfer(worker, req_id, body)
                     else:
                         self._complete(worker, req_id, ST_ERR, 0,
                                        f"unknown op {op}".encode())
@@ -689,7 +714,8 @@ class RingClient:
     # -- plumbing --------------------------------------------------------
 
     _OP_NAMES = {OP_PUT: "ring.put", OP_GET: "ring.get",
-                 OP_DOC: "ring.doc", OP_MEMBER: "ring.member"}
+                 OP_DOC: "ring.doc", OP_MEMBER: "ring.member",
+                 OP_XFER: "ring.transfer"}
 
     def _submit(self, op: int, group: int, flags: int, token: int,
                 body: bytes, deadline_s: float = 2.0) -> "RingFuture":
@@ -821,6 +847,20 @@ class RingClient:
         fut = self._submit(OP_MEMBER, group, 0, 0,
                            json.dumps({"group": group, "op": op,
                                        "peer": peer}).encode())
+        status, leader, body = fut.wait_raw(10.0)
+        if status == ST_OK:
+            return json.loads(body.decode("utf-8"))
+        if status == ST_NOT_LEADER:
+            raise NotLeaderError(group, leader)
+        raise ValueError(body.decode("utf-8", "replace"))
+
+    def transfer(self, group: int, target: int) -> dict:
+        """POST /transfer over the ring (op 5): arm a leadership
+        transfer at the engine — same surface as RaftDB.transfer."""
+        from raftsql_tpu.runtime.db import NotLeaderError
+        fut = self._submit(OP_XFER, group, 0, 0,
+                           json.dumps({"group": group,
+                                       "target": target}).encode())
         status, leader, body = fut.wait_raw(10.0)
         if status == ST_OK:
             return json.loads(body.decode("utf-8"))

@@ -330,6 +330,19 @@ def main(argv=None) -> None:
                          "(raftsql_tpu/obs/): per-proposal lifecycle "
                          "spans + the on-device event ring, exported "
                          "at GET /trace (Perfetto) and GET /events")
+    ap.add_argument("--placement", action="store_true",
+                    help="traffic-aware leadership placement "
+                         "(raftsql_tpu/placement/): a controller "
+                         "thread watches the per-group traffic feed "
+                         "and issues graceful leadership transfers "
+                         "(POST /transfer machinery, thesis §3.10) to "
+                         "balance hot groups across peers; fused/mesh "
+                         "runtimes only")
+    ap.add_argument("--placement-interval", type=float, default=0.5,
+                    help="seconds between placement passes")
+    ap.add_argument("--placement-imbalance", type=float, default=2.0,
+                    help="hottest/coldest per-peer load ratio that "
+                         "triggers a transfer")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -384,6 +397,16 @@ def main(argv=None) -> None:
                          lease_ticks=args.lease_ticks,
                          max_clock_skew=args.max_clock_skew)
     _watch_fatal(rdb)
+    if args.placement:
+        if not (args.fused or args.mesh):
+            ap.error("--placement requires --fused or --mesh (the "
+                     "co-located runtimes own the traffic feed)")
+        from raftsql_tpu.placement import PlacementController
+        pc = PlacementController(
+            rdb.pipe.node, interval_s=args.placement_interval,
+            imbalance=args.placement_imbalance)
+        rdb.placement = pc
+        pc.start()
     if args.workers > 0:
         _serve_workers(rdb, args)
         return

@@ -74,6 +74,20 @@ class NodeMetrics:
     faults_enospc: int = 0
     faults_fsync_stalls: int = 0
     faults_skew_ticks: int = 0
+    # Leadership-transfer plane (PR 11): admin/placement-initiated
+    # transfers by outcome — initiated (latch armed), completed (the
+    # target took leadership), aborted (deadline passed or leadership
+    # settled elsewhere; the group re-opened for proposals either way),
+    # refused (validation failed: no leader, in-flight transfer,
+    # learner/non-voter target).  The stall histogram buckets each
+    # finished transfer's proposal-intake pause in ticks (power-of-2
+    # buckets, keys are strings so prom_samples renders
+    # transfers_stall_ticks_hist{bucket=...}).
+    transfers_initiated: int = 0
+    transfers_completed: int = 0
+    transfers_aborted: int = 0
+    transfers_refused: int = 0
+    transfer_stall_hist: Dict[str, int] = field(default_factory=dict)
     # Per-phase tick wall time, accumulated by RaftNode.tick (SURVEY.md
     # §5.1 live profiling): staging (installs + inbox build) / device
     # step / WAL fsync / send / publish.
@@ -83,6 +97,15 @@ class NodeMetrics:
     t_send_ms: float = 0.0
     t_publish_ms: float = 0.0
     started_at: float = field(default_factory=time.monotonic)
+
+    def note_transfer_stall(self, ticks: int) -> None:
+        """Bucket one finished transfer's intake-stall duration."""
+        b = 1
+        t = max(int(ticks), 1)
+        while b < t:
+            b <<= 1
+        k = str(b)
+        self.transfer_stall_hist[k] = self.transfer_stall_hist.get(k, 0) + 1
 
     def snapshot(self) -> dict:
         up = max(time.monotonic() - self.started_at, 1e-9)
@@ -120,6 +143,13 @@ class NodeMetrics:
                 "enospc": self.faults_enospc,
                 "fsync_stalls": self.faults_fsync_stalls,
                 "skew_ticks": self.faults_skew_ticks,
+            },
+            "transfers": {
+                "initiated": self.transfers_initiated,
+                "completed": self.transfers_completed,
+                "aborted": self.transfers_aborted,
+                "refused": self.transfers_refused,
+                "stall_ticks_hist": dict(self.transfer_stall_hist),
             },
             "uptime_s": round(up, 3),
             "commits_per_s": round(self.commits / up, 3),
@@ -191,11 +221,13 @@ class GroupTraffic:
         self._last_t = now
 
     def doc(self, leader_of=None, shard_of=None,
-            k: Optional[int] = None) -> dict:
+            k: Optional[int] = None, transferring=None) -> dict:
         """Aggregate totals + the top-K hot-groups table
         (group id, 1-based leader, EWMA propose/commit rates, raw
         totals; a `shard` column on sharded runtimes so the placement
-        story can move hot groups between shards)."""
+        story can move hot groups between shards; a `transferring`
+        flag when the runtime supplies the set of groups with a
+        leadership transfer in flight)."""
         with self._mu:
             self._advance_rates_locked()
             rp = self._rate_p.copy()
@@ -219,6 +251,8 @@ class GroupTraffic:
                    "acked": int(self.acked[g])}
             if callable(shard_of):
                 row["shard"] = int(shard_of(g))
+            if transferring is not None:
+                row["transferring"] = g in transferring
             hot.append(row)
         return {"proposed": int(self.proposed.sum()),
                 "committed": int(self.committed.sum()),

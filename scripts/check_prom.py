@@ -21,7 +21,10 @@ Per scraped node it checks:
      the JSON can never silently miss the exposition);
   4. a few load-bearing series are present: the per-group top-K
      (`raftsql_group_propose_rate`), the tick-phase summary
-     (`raftsql_tick_phase_ms`), and the core counters.
+     (`raftsql_tick_phase_ms`), the core counters, and the
+     leadership-transfer outcome counters; the CI boot additionally
+     enables `--placement` and requires the placement-controller
+     gauges (`raftsql_placement_*`).
 
 tests/test_obs.py imports `parse_prom` so the in-process tests and
 this live-node lint enforce the same grammar.  Exit 0 = clean.
@@ -170,7 +173,8 @@ def _get(host: str, port: int, path: str, headers=None,
         conn.close()
 
 
-def lint_url(host: str, port: int, label: str = "") -> None:
+def lint_url(host: str, port: int, label: str = "",
+             extra_required: Tuple[str, ...] = ()) -> None:
     tag = label or f"{host}:{port}"
     status, _h, json_text = _get(host, port, "/metrics")
     assert status == 200, (tag, status)
@@ -195,8 +199,13 @@ def lint_url(host: str, port: int, label: str = "") -> None:
     assert not missing, (f"{tag}: {len(missing)} JSON fields missing "
                          f"from the exposition, e.g. {missing[:5]}")
 
-    for required in ("raftsql_ticks", "raftsql_commits",
-                     "raftsql_faults_crashes"):
+    required_series = ("raftsql_ticks", "raftsql_commits",
+                       "raftsql_faults_crashes",
+                       "raftsql_transfers_initiated",
+                       "raftsql_transfers_completed",
+                       "raftsql_transfers_aborted",
+                       "raftsql_transfers_refused") + extra_required
+    for required in required_series:
         assert any(n == required for (n, _l) in samples), \
             f"{tag}: required series {required} absent"
     print(f"check_prom: {tag}: OK ({len(samples)} series, "
@@ -219,7 +228,8 @@ def lint_fused_server(engine: str) -> None:
     proc = subprocess.Popen(
         [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
          "--port", str(port), "--groups", "2", "--tick", "0.005",
-         "--http-engine", engine],
+         "--http-engine", engine, "--placement",
+         "--placement-interval", "0.2"],
         cwd=tmp, env=env, stdout=logf, stderr=logf)
     try:
         deadline = time.monotonic() + 90
@@ -260,7 +270,11 @@ def lint_fused_server(engine: str) -> None:
         for i in range(8):
             assert put(f"INSERT INTO t (v) VALUES ('{i}')",
                        i % 2) == 204
-        lint_url("127.0.0.1", port, label=f"fused/{engine}")
+        lint_url("127.0.0.1", port, label=f"fused/{engine}",
+                 extra_required=("raftsql_placement_issued",
+                                 "raftsql_placement_refused",
+                                 "raftsql_placement_last_imbalance",
+                                 "raftsql_placement_backoff_groups"))
     finally:
         proc.terminate()
         try:

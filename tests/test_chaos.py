@@ -338,6 +338,46 @@ def test_family_tcp_transport(tmp_path):
     assert r["commits"] > 20
 
 
+# -- leadership-transfer nemesis (PR 11) -------------------------------
+
+def test_family_transfer_under_nemesis(tmp_path):
+    """Graceful transfers racing drops, a leader-targeted partition, an
+    asym cut, skew and a crash under acked-PUT load — every transfer
+    resolves, at least one completes, post-transfer probes commit, and
+    the run reproduces bit-for-bit."""
+    from raftsql_tpu.chaos import TransferChaosRunner, generate_transfers
+    plan = generate_transfers(0)
+    r1 = TransferChaosRunner(plan, str(tmp_path / "a")).run()
+    r2 = TransferChaosRunner(plan, str(tmp_path / "b")).run()
+    assert r1 == r2
+    assert r1["transfers_requested"] >= 6
+    assert r1["transfers_completed"] >= 1
+    assert r1["transfer_probes_confirmed"] >= 1
+    assert r1["partitions"] >= 1 and r1["crashes"] >= 1
+    assert r1["plan_digest"] == plan.digest()
+
+
+def test_transfer_falsification_pair(tmp_path, monkeypatch):
+    """The robustness headline: the SAME directed lagging-target
+    schedule must CATCH the deliberately broken transfer kernel
+    (unsafe_transfer: depose the leader before the target caught up —
+    the target cannot win the election, the transfer aborts) and PASS
+    the correct kernel (catch-up gate holds the TimeoutNow until the
+    target's match_index is current, then it wins immediately)."""
+    from raftsql_tpu.chaos import (TransferChaosRunner,
+                                   falsification_transfer_plan)
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+    monkeypatch.setenv("RAFTSQL_FLIGHT_DIR", str(tmp_path / "flight"))
+    with pytest.raises(InvariantViolation,
+                       match="TRANSFER-AVAILABILITY"):
+        TransferChaosRunner(falsification_transfer_plan(0, broken=True),
+                            str(tmp_path / "broken")).run()
+    r = TransferChaosRunner(falsification_transfer_plan(0, broken=False),
+                            str(tmp_path / "ok")).run()
+    assert r["transfers_completed"] == 1
+    assert r["max_transfer_stall"] <= 60
+
+
 # -- threaded RaftNode cluster scenarios -------------------------------
 
 def test_node_cluster_partition_leader_kill_restart(tmp_path):

@@ -1,0 +1,108 @@
+"""api/client.py unit tests: leader-cache transitions on 421 hints.
+
+PR 11 makes leadership MOVE on purpose (graceful transfers), so the
+client's 421 handling is now on the hot path: a hint naming a node
+other than the cached leader must invalidate the cache and rotate the
+request to the new leader IMMEDIATELY — finishing the old rotation
+first would spend a full round of timeouts on nodes known not to lead.
+No sockets here: `raw` is monkeypatched, the cache logic is the unit.
+"""
+from raftsql_tpu.api.client import RaftSQLClient
+
+
+def _client():
+    # Ports never dialled — raw() is replaced in every test that sends.
+    return RaftSQLClient([10001, 10002, 10003], timeout_s=0.2,
+                         backoff_s=0.001, backoff_cap_s=0.002)
+
+
+def test_note_leader_change_detection():
+    c = _client()
+    # Empty cache: any valid hint is a change.
+    assert c._note_leader(0, {"X-Raft-Leader": "2"}) is True
+    assert c._leader[0] == 1
+    # Same hint again: cache already right, no rotation needed.
+    assert c._note_leader(0, {"X-Raft-Leader": "2"}) is False
+    assert c._leader[0] == 1
+    # Different hint (leadership transferred): change, cache follows.
+    assert c._note_leader(0, {"X-Raft-Leader": "3"}) is True
+    assert c._leader[0] == 2
+    # Groups are independent.
+    assert c._note_leader(5, {"X-Raft-Leader": "1"}) is True
+    assert c._leader[0] == 2 and c._leader[5] == 0
+
+
+def test_note_leader_hintless_421_invalidates():
+    c = _client()
+    assert c._note_leader(0, {"X-Raft-Leader": "1"}) is True
+    # 421 with no (or junk) hint: the cached leader is demonstrably
+    # wrong — drop it so the next rotation is unbiased.
+    assert c._note_leader(0, {}) is False
+    assert 0 not in c._leader
+    c._leader[0] = 1
+    assert c._note_leader(0, {"X-Raft-Leader": "zap"}) is False
+    assert 0 not in c._leader
+
+
+def test_put_chases_moved_leader_immediately():
+    c = _client()
+    c._leader[0] = 0                       # stale: node 0 used to lead
+    calls = []
+
+    def fake_raw(node, method, path="/", body="", headers=None,
+                 timeout_s=None):
+        calls.append(node)
+        if node == 2:
+            return 204, {"X-Raft-Session": "7"}, ""
+        # Everyone else redirects to node 3 (idx 2): a transfer moved
+        # leadership mid-flight.
+        return 421, {"X-Raft-Leader": "3"}, "not leader"
+
+    c.raw = fake_raw
+    assert c.put("insert into kv values ('a','1')", deadline_s=5) == 7
+    # The changed hint must ABANDON the rotation: exactly one miss at
+    # the stale leader, then straight to the new one — the third node
+    # is never dialled.
+    assert calls == [0, 2]
+    assert c._leader[0] == 2
+
+
+def test_put_same_hint_keeps_rotating():
+    c = _client()
+    c._leader[0] = 2                       # cache already names idx 2
+    calls = []
+
+    def fake_raw(node, method, path="/", body="", headers=None,
+                 timeout_s=None):
+        calls.append(node)
+        if len(calls) >= 4:
+            return 204, {}, ""
+        # idx 2 (the cached leader) answers 421 naming ITSELF — e.g.
+        # it is mid-step-down; no rotation reset, just move on.
+        return 421, {"X-Raft-Leader": "3"}, "not yet"
+
+    c.raw = fake_raw
+    assert c.put("insert into kv values ('b','2')", deadline_s=5) is None
+    # Cached leader first, then the round-robin remainder — the
+    # self-naming hint must NOT restart the order (that would hammer
+    # one node in a tight loop).
+    assert calls[0] == 2
+    assert set(calls[:3]) == {0, 1, 2}
+
+
+def test_get_rotates_on_changed_hint():
+    c = _client()
+    c._leader[0] = 0
+    calls = []
+
+    def fake_raw(node, method, path="/", body="", headers=None,
+                 timeout_s=None):
+        calls.append(node)
+        if node == 1:
+            return 200, {}, "42"
+        return 421, {"X-Raft-Leader": "2"}, "moved"
+
+    c.raw = fake_raw
+    assert c.get("select v from kv", linear=True, deadline_s=5) == "42"
+    assert calls == [0, 1]
+    assert c._leader[0] == 1

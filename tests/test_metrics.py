@@ -87,3 +87,85 @@ def test_concurrent_record_and_percentile_smoke():
     assert len(t._samples) <= 128
     p = t.percentile(0.5)
     assert p == p                             # a real number by now
+
+
+# ---------------------------------------------------------------------------
+# GroupTraffic: the EWMA feed the placement controller balances on.
+# Time is driven by rewinding _last_t (the clock the rate window uses),
+# so windows are exact and the tests never sleep.
+
+
+def _window(t, seconds=1.0):
+    """Force one EWMA window of `seconds` onto the traffic object."""
+    t._last_t -= seconds
+    with t._mu:
+        t._advance_rates_locked()
+
+
+def test_group_traffic_ewma_decays_to_zero_when_idle():
+    from raftsql_tpu.utils.metrics import GroupTraffic
+    t = GroupTraffic(4, alpha=0.5)
+    t.add_propose([1], [100])
+    _window(t)
+    hot = t._rate_p[1]
+    assert hot > 0
+    # The group goes idle: every further window sees zero new
+    # proposals, so the EWMA must decay geometrically toward zero —
+    # a placement controller keyed on stale heat would move leadership
+    # of groups nobody writes to any more.
+    prev = hot
+    for _ in range(20):
+        _window(t)
+        assert t._rate_p[1] <= prev
+        prev = t._rate_p[1]
+    assert 0.0 <= t._rate_p[1] < hot * 1e-3
+    # Untouched groups never acquire a rate at all.
+    assert t._rate_p[0] == 0.0 and t._rate_p[2] == 0.0
+
+
+def test_group_traffic_idle_group_total_still_listed():
+    from raftsql_tpu.utils.metrics import GroupTraffic
+    t = GroupTraffic(2, alpha=0.5)
+    t.add_propose([0], [10])
+    for _ in range(30):
+        _window(t)
+    # Rate has decayed to ~0 but the all-time total keeps the row in
+    # the hot-groups table (volume history is still reportable).
+    doc = t.doc()
+    assert [r["group"] for r in doc["hot_groups"]] == [0]
+    assert doc["hot_groups"][0]["propose_rate"] == 0.0
+    assert doc["hot_groups"][0]["proposed"] == 10
+
+
+def test_group_traffic_topk_ties_rank_by_group_id():
+    from raftsql_tpu.utils.metrics import GroupTraffic
+    t = GroupTraffic(8, top_k=8)
+    # Four groups with IDENTICAL totals and no rate window yet: the
+    # ranking must be deterministic (ascending group id on ties), not
+    # an artifact of sort instability.
+    t.add_propose([7, 2, 5, 1], [10, 10, 10, 10])
+    ids = [r["group"] for r in t.doc()["hot_groups"]]
+    assert ids == [1, 2, 5, 7]
+    # Stable across repeated scrapes.
+    assert ids == [r["group"] for r in t.doc()["hot_groups"]]
+
+
+def test_group_traffic_topk_truncation_under_ties_is_stable():
+    from raftsql_tpu.utils.metrics import GroupTraffic
+    t = GroupTraffic(8, top_k=2)
+    t.add_propose([3, 6, 4], [5, 5, 5])
+    # k=2 must pick the same two of the three tied groups every time:
+    # the lowest ids win.
+    for _ in range(3):
+        assert [r["group"] for r in t.doc()["hot_groups"]] == [3, 4]
+
+
+def test_group_traffic_rate_breaks_total_ties():
+    from raftsql_tpu.utils.metrics import GroupTraffic
+    t = GroupTraffic(4, top_k=4, alpha=1.0)
+    t.add_propose([0, 1], [10, 10])
+    _window(t)                    # both groups: rate 10/s
+    t.add_propose([1], [50])      # group 1 gets hot
+    _window(t)
+    ids = [r["group"] for r in t.doc()["hot_groups"]]
+    assert ids[0] == 1            # rate-first ranking

@@ -113,7 +113,6 @@ def test_forward_reclaimed_when_follower_becomes_leader(tmp_path):
     makes the requeue safe).  Found by the process-plane read nemesis:
     the entry node's PUT stalled for the whole deadline while it was
     the leader that could have committed it."""
-    import numpy as np
     from raftsql_tpu.config import LEADER
     from raftsql_tpu.runtime.db import _expand_commit_item
 

@@ -1,0 +1,285 @@
+"""Leadership-transfer plane (PR 11): the TimeoutNow device kernel
+(core/step.py Phases 1b/6/9) through the host latch
+(runtime/hostplane.py transfer_leadership/_transfer_arm/
+_transfer_advance), refusal taxonomy, abort-on-deadline re-opening the
+group, the TransferAvailability chaos invariant, the placement
+controller's balancing decision, and transfer-plan digest stability.
+The transfer-under-nemesis family itself runs in `make chaos-transfer`
+(tests/test_chaos.py smoke-gates it).
+"""
+import pytest
+
+from raftsql_tpu.chaos.invariants import (InvariantViolation,
+                                          TransferAvailability)
+from raftsql_tpu.config import RaftConfig
+from raftsql_tpu.runtime.fused import FusedClusterNode
+from raftsql_tpu.runtime.node import TransferRefused
+from raftsql_tpu.transport.faults import partition_peer
+
+
+def mkcfg(groups=2):
+    return RaftConfig(num_groups=groups, num_peers=3, log_window=32,
+                      max_entries_per_msg=4, election_ticks=10,
+                      tick_interval_s=0.0)
+
+
+def elect(node, max_ticks=200):
+    for t in range(max_ticks):
+        node.tick()
+        if t > 10 and (node._hints >= 0).all():
+            return
+    raise AssertionError("no full leadership within budget")
+
+
+def settle(node, group, target, max_ticks=80):
+    """Tick until `group`'s hint names `target` AND the latch cleared
+    (completion is recorded one hint-refresh after the election)."""
+    for _ in range(max_ticks):
+        node.tick()
+        if int(node._hints[group]) == target \
+                and group not in node.transferring_groups():
+            return
+    raise AssertionError(
+        f"transfer never settled: hint={int(node._hints[group])} "
+        f"inflight={node.transferring_groups()}")
+
+
+def test_transfer_completes_and_logs_event(tmp_path):
+    node = FusedClusterNode(mkcfg(), str(tmp_path))
+    try:
+        elect(node)
+        g = 0
+        old = int(node._hints[g])
+        target = (old + 1) % 3
+        node.propose_many(g, [b"SET k0 v0"])
+        got = node.transfer_leadership(g, target)
+        assert got["from"] == old + 1 and got["target"] == target + 1
+        assert g in node.transferring_groups()
+        settle(node, g, target)
+        doc = node.transfers_doc()
+        assert doc["in_flight"] == {}
+        ev = doc["recent"][-1]
+        assert ev["outcome"] == "completed"
+        assert ev["group"] == g and ev["to"] == target + 1
+        assert ev["stall_ticks"] >= 0
+        assert node.metrics.transfers_initiated == 1
+        assert node.metrics.transfers_completed == 1
+        assert node.metrics.transfers_aborted == 0
+        assert sum(node.metrics.transfer_stall_hist.values()) == 1
+        # The group serves under its new leader: a post-transfer
+        # proposal must commit.
+        before = int(node._hard[0, g, 2])
+        node.propose_many(g, [b"SET k0 v1"])
+        for _ in range(20):
+            node.tick()
+        assert int(node._hard[0, g, 2]) > before
+    finally:
+        node.stop()
+
+
+def test_transfer_refusal_taxonomy(tmp_path):
+    node = FusedClusterNode(mkcfg(), str(tmp_path))
+    try:
+        with pytest.raises(ValueError):
+            node.transfer_leadership(99, 0)
+        with pytest.raises(ValueError):
+            node.transfer_leadership(0, 99)
+        # Nothing elected yet: no leader to transfer from.
+        with pytest.raises(TransferRefused, match="no leader"):
+            node.transfer_leadership(0, 0)
+        elect(node)
+        lead = int(node._hints[0])
+        with pytest.raises(TransferRefused, match="already leads"):
+            node.transfer_leadership(0, lead)
+        # One in flight per group: the second request bounces off the
+        # latch without touching device state.
+        target = (lead + 1) % 3
+        node.transfer_leadership(0, target)
+        with pytest.raises(TransferRefused, match="in flight"):
+            node.transfer_leadership(0, (lead + 2) % 3)
+        # Only engine refusals count — range errors are caller bugs.
+        assert node.metrics.transfers_refused == 3
+        settle(node, 0, target)
+    finally:
+        node.stop()
+
+
+def test_transfer_aborts_on_deadline_and_group_reopens(tmp_path):
+    node = FusedClusterNode(mkcfg(), str(tmp_path))
+    try:
+        elect(node)
+        g = 0
+        old = int(node._hints[g])
+        target = (old + 1) % 3
+        # Freeze the target's replication: the catch-up gate (Phase 9)
+        # can never observe a caught-up match_index, so the latch must
+        # hit its deadline and clear.
+        node.propose_many(g, [b"SET k0 v0", b"SET k0 v1"])
+        node.transfer_leadership(g, target, deadline_ticks=12)
+        for _ in range(40):
+            node.inboxes = partition_peer(node.inboxes, target)
+            node.tick()
+            if g not in node.transferring_groups():
+                break
+        doc = node.transfers_doc()
+        assert doc["in_flight"] == {}
+        assert doc["recent"][-1]["outcome"] == "aborted"
+        assert node.metrics.transfers_aborted == 1
+        # Aborted transfer re-opens the group under the OLD leader:
+        # intake resumes and commits advance.
+        assert int(node._hints[g]) == old
+        before = int(node._hard[0, g, 2])
+        node.propose_many(g, [b"SET k0 v2"])
+        for _ in range(20):
+            node.tick()
+        assert int(node._hard[0, g, 2]) > before
+    finally:
+        node.stop()
+
+
+# -- TransferAvailability invariant (pure host logic) -------------------
+
+
+def _avail():
+    return TransferAvailability(election_ticks=10, deadline_ticks=40,
+                                max_stall_ticks=30, probe_ticks=20)
+
+
+def test_availability_must_complete_abort_fires():
+    a = _avail()
+    a.note_issued(5, 0, must_complete=True)
+    with pytest.raises(InvariantViolation,
+                       match="TRANSFER-AVAILABILITY"):
+        a.note_outcome(21, 0, "aborted", 16)
+
+
+def test_availability_stall_bound_fires():
+    a = _avail()
+    a.note_issued(5, 0, must_complete=True)
+    with pytest.raises(InvariantViolation, match="stalled"):
+        a.note_outcome(50, 0, "completed", 45)
+
+
+def test_availability_ordinary_abort_is_legal():
+    a = _avail()
+    a.note_issued(5, 0, must_complete=False)
+    a.note_outcome(21, 0, "aborted", 16)
+    assert a.aborted == 1 and a.max_stall == 16
+    a.check(200)                       # nothing pending: no violation
+
+
+def test_availability_stuck_latch_fires():
+    a = _avail()
+    a.note_issued(5, 1, must_complete=False)
+    a.check(5 + 40 + 2 * 10)           # exactly at the margin: fine
+    with pytest.raises(InvariantViolation, match="unresolved"):
+        a.check(5 + 40 + 2 * 10 + 1)
+    with pytest.raises(InvariantViolation, match="never resolved"):
+        a.final_check(199)
+
+
+def test_availability_probe_deadline_and_crash_void():
+    a = _avail()
+    a.arm_probe(10, 0, "v7")
+    a.probe_committed("v7")
+    assert a.probes_confirmed == 1
+    a.check(100)
+    a.arm_probe(100, 1, "v8")
+    with pytest.raises(InvariantViolation, match="stopped serving"):
+        a.check(121)
+    # Crash voids in-flight probes and pending transfers.
+    a = _avail()
+    a.note_issued(5, 0, must_complete=True)
+    a.arm_probe(5, 0, "v9")
+    a.note_crash()
+    a.check(500)
+    a.final_check(500)
+
+
+# -- placement controller ----------------------------------------------
+
+
+class _FakeEngine:
+    """Minimal engine surface for PlacementController: a real
+    GroupTraffic feed plus scripted leaders and a recording
+    transfer_leadership."""
+
+    def __init__(self, leaders, rates):
+        from raftsql_tpu.utils.metrics import GroupTraffic
+        self.cfg = RaftConfig(num_groups=len(leaders), num_peers=3,
+                              tick_interval_s=0.0)
+        self.traffic = GroupTraffic(len(leaders), alpha=1.0)
+        for g, n in enumerate(rates):
+            self.traffic.add_propose(g, n)
+        # One whole EWMA window so add_propose counts become rates.
+        self.traffic._last_t -= 1.0
+        self.leaders = list(leaders)
+        self.transfers = []
+        self.refuse = False
+
+    def leader_of(self, g):
+        return self.leaders[g]
+
+    def transfer_leadership(self, g, target):
+        if self.refuse:
+            raise TransferRefused(g, "transfer already in flight")
+        self.transfers.append((g, target))
+
+
+def test_placement_moves_hot_group_to_cold_peer():
+    from raftsql_tpu.placement.controller import PlacementController
+    # Peer 0 leads two hot groups; peer 2 leads nothing.
+    eng = _FakeEngine(leaders=[0, 0, 1, 1], rates=[60, 40, 8, 0])
+    pc = PlacementController(eng, imbalance=2.0, min_rate=1.0)
+    d = pc.evaluate()
+    assert d is not None and d["outcome"] == "pending"
+    # The hottest group (60/s) exceeds half the gap (100 vs 0) is
+    # false — 60 > 50 — so the mover must pick the 40/s group.
+    assert eng.transfers == [(1, 2)]
+    assert d["group"] == 1 and d["to"] == 3
+    assert pc.issued == 1
+
+
+def test_placement_idle_cluster_never_churns():
+    from raftsql_tpu.placement.controller import PlacementController
+    eng = _FakeEngine(leaders=[0, 0, 1, 2], rates=[0, 0, 0, 0])
+    pc = PlacementController(eng, imbalance=2.0, min_rate=1.0)
+    assert pc.evaluate() is None
+    assert eng.transfers == []
+
+
+def test_placement_refusal_backs_off():
+    from raftsql_tpu.placement.controller import PlacementController
+    eng = _FakeEngine(leaders=[0, 0], rates=[50, 30])
+    eng.refuse = True
+    pc = PlacementController(eng, imbalance=2.0, min_rate=1.0)
+    d = pc.evaluate()
+    assert d["outcome"].startswith("refused")
+    assert pc.refused == 1
+    # The refused group is in backoff; the pass may fall through to
+    # another candidate or to None, but must NOT re-issue group 1.
+    eng2_calls = len(eng.transfers)
+    pc.evaluate()
+    assert len(eng.transfers) == eng2_calls
+    assert pc.metrics_doc()["backoff_groups"] >= 1
+
+
+# -- plan + digest stability -------------------------------------------
+
+
+def test_transfer_plan_digests_are_stable():
+    from raftsql_tpu.chaos.schedule import (falsification_transfer_plan,
+                                            generate_transfers)
+    p1, p2 = generate_transfers(7), generate_transfers(7)
+    assert p1 == p2 and p1.digest() == p2.digest()
+    assert generate_transfers(8).digest() != p1.digest()
+    broken = falsification_transfer_plan(0, broken=True)
+    correct = falsification_transfer_plan(0, broken=False)
+    assert broken.unsafe_transfer and not correct.unsafe_transfer
+    # Identical SCHEDULE, differing only in which kernel compiles in —
+    # the falsification pair's whole point.
+    db, dc = broken.describe(), correct.describe()
+    db.pop("unsafe_transfer"), dc.pop("unsafe_transfer")
+    assert db == dc
+    ev = broken.transfers[0]
+    assert ev.must_complete and ev.tick == broken.partitions[0].end
