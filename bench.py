@@ -58,6 +58,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 NORTH_STAR_COMMITS_PER_SEC = 1.0e8
@@ -386,11 +387,80 @@ def bench_reads(peers: int = 3, seconds: float = 2.0) -> tuple:
                 1)
             rates["follower"] = round(timed(
                 lambda: fdb.query(sel, mode="follower")), 1)
+
+            # --- PR 12 rung: batched ReadIndex under concurrency.
+            # Every pending linear read of a tick shares ONE quorum
+            # round (runtime/node.py read_join; lease disabled so each
+            # read takes the §6.4 path) — the serial read_index rung
+            # above is the before number.
+            node.lease_read = lambda g: None
+            try:
+                nthreads = 128
+                counts = [0] * nthreads
+                stop_at = time.monotonic() + seconds
+
+                def rloop(i: int) -> None:
+                    while time.monotonic() < stop_at:
+                        ldb.query(sel, mode="linear")
+                        counts[i] += 1
+                threads = [threading.Thread(target=rloop, args=(i,),
+                                            daemon=True)
+                           for i in range(nthreads)]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.monotonic() - t0
+                rates["read_index_mt128"] = round(sum(counts) / dt, 1)
+            finally:
+                node.lease_read = saved
+
+            # --- PR 12 rungs: the shm worker plane vs the ring.  One
+            # RingServer over the leader's RaftDB, two worker slots:
+            # slot 0 maps the shared-memory snapshot (zero-round-trip
+            # fast path), slot 1 runs with the plane off so every GET
+            # pays the full ring round trip — same engine, same query,
+            # the pair is the before/after of runtime/shm.py.
+            from raftsql_tpu.runtime.ring import RingClient, RingServer
+            ring = RingServer(ldb, os.path.join(d, "rings"), 2)
+            ring.start()
+            shm_c = ring_c = None
+            try:
+                shm_c = RingClient(os.path.join(d, "rings"), 0)
+                os.environ["RAFTSQL_SHM_READS"] = "0"
+                try:
+                    ring_c = RingClient(os.path.join(d, "rings"), 1)
+                finally:
+                    del os.environ["RAFTSQL_SHM_READS"]
+                rates["ring_local"] = round(timed(
+                    lambda: ring_c.query(sel)), 1)
+                rates["shm_local"] = round(timed(
+                    lambda: shm_c.query(sel)), 1)
+                rates["ring_session"] = round(timed(
+                    lambda: ring_c.query(sel, mode="session",
+                                         watermark=wm)), 1)
+                rates["shm_session"] = round(timed(
+                    lambda: shm_c.query(sel, mode="session",
+                                        watermark=wm)), 1)
+                rates["shm_linear"] = round(timed(
+                    lambda: shm_c.query(sel, mode="linear")), 1)
+                shm_stats = {"shm_hits": shm_c._shm_hits,
+                             "shm_fallbacks": shm_c._shm_fallbacks}
+            finally:
+                for c in (shm_c, ring_c):
+                    if c is not None:
+                        c.close()
+                ring.stop()
+
             m = node.metrics
             extras = {"reads_ladder": rates,
                       "lease_grants": m.lease_grants,
                       "lease_expiries": m.lease_expiries,
-                      "lease_degrades": m.lease_degrades}
+                      "lease_degrades": m.lease_degrades,
+                      "read_index_batched": m.reads_read_index_batched,
+                      "read_batch_hist": dict(m.read_batch_hist)}
+            extras.update(shm_stats)
             _log(f"reads ladder: {rates}")
             return float(rates["lease"]), extras
         finally:

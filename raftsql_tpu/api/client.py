@@ -15,6 +15,11 @@ use:
   * leader caching: a 421 Misdirected Request carries X-Raft-Leader
     (linearizable reads, membership writes) — the hint is remembered
     per group and tried first next time;
+  * PROACTIVE routing hints (PR 12): /healthz publishes each group's
+    role/leader plus the node's remaining read-lease seconds; the
+    client sweeps it (refresh_hints) so writes go leader-first and
+    linear reads go lease-holder-first WITHOUT paying a 421 round
+    trip at all in steady state;
   * RETRY TOKENS: every logical PUT draws one 64-bit token, sent as
     X-Raft-Retry-Token on every attempt.  The server pins the
     proposal's envelope id to it (runtime/envelope.py), so however many
@@ -136,7 +141,8 @@ class RaftSQLClient:
                  backoff_s: float = 0.05, backoff_cap_s: float = 1.0,
                  rng: Optional[random.Random] = None,
                  max_conns_per_node: int = 64,
-                 max_idle_per_node: int = 32):
+                 max_idle_per_node: int = 32,
+                 hint_refresh_s: float = 2.0):
         import threading
         self.nodes: List[Tuple[str, int]] = []
         for n in nodes:
@@ -148,9 +154,13 @@ class RaftSQLClient:
         self.timeout_s = timeout_s
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
+        self.hint_refresh_s = hint_refresh_s
         self._rng = rng or random.Random()
         self._mu = threading.Lock()            # leader cache + rr cursor
         self._leader: Dict[int, int] = {}      # group -> node index
+        self._lease: Dict[int, Tuple[int, float]] = {}
+        #   group -> (node index, monotonic lease-hint expiry)
+        self._hints_at = 0.0                   # last /healthz sweep
         self._rr = 0                           # round-robin cursor
         self._pools = [_NodePool(h, p, max_conns_per_node,
                                  max_idle_per_node)
@@ -197,9 +207,11 @@ class RaftSQLClient:
                 pool.release(conn, keep)
         raise AssertionError("unreachable")    # pragma: no cover
 
-    def _order(self, group: int, node: Optional[int]) -> List[int]:
-        """Attempt order: pinned node only, else cached leader first,
-        then round-robin over the rest."""
+    def _order(self, group: int, node: Optional[int],
+               prefer: Optional[int] = None) -> List[int]:
+        """Attempt order: pinned node only, else `prefer` (a live lease
+        hint) first, then cached leader, then round-robin over the
+        rest."""
         if node is not None:
             return [node]
         n = len(self.nodes)
@@ -208,10 +220,72 @@ class RaftSQLClient:
             self._rr += 1
             lead = self._leader.get(group)
         order = [(start + i) % n for i in range(n)]
-        if lead is not None and lead in order:
-            order.remove(lead)
-            order.insert(0, lead)
+        for front in (lead, prefer):
+            if front is not None and front in order:
+                order.remove(front)
+                order.insert(0, front)
         return order
+
+    # -- routing hints (PR 12 front router) ----------------------------
+
+    def refresh_hints(self, timeout_s: float = 1.0) -> int:
+        """Sweep GET /healthz and prime the routing tables from the
+        per-group rows (runtime/db.py health_doc): a node whose row
+        says `role == "leader"` is the group's write target, and a node
+        reporting `lease_s > 0` holds the group's read lease RIGHT NOW
+        — a linear read routed there is served from the local lease
+        fast path instead of paying a quorum round.  Steady state then
+        has no 421 redirects at all: the first request of a fresh
+        client already goes to the right node.  Returns the number of
+        groups with a usable leader hint."""
+        n = len(self.nodes)
+        leaders: Dict[int, int] = {}
+        leases: Dict[int, Tuple[int, float]] = {}
+        now = time.monotonic()
+        for idx in range(n):
+            doc = self.health(idx, timeout_s=timeout_s)
+            if not doc:
+                continue
+            for key, row in (doc.get("groups") or {}).items():
+                try:
+                    g = int(key)
+                except (TypeError, ValueError):
+                    continue
+                if row.get("role") == "leader":
+                    leaders[g] = idx           # self-report wins
+                else:
+                    hint = row.get("leader")
+                    if isinstance(hint, int) and hint > 0:
+                        leaders.setdefault(g, (hint - 1) % n)
+                lease = row.get("lease_s")
+                if isinstance(lease, (int, float)) and lease > 0:
+                    leases[g] = (idx, now + float(lease))
+        with self._mu:
+            self._leader.update(leaders)
+            self._lease.update(leases)
+            self._hints_at = time.monotonic()
+        return len(leaders)
+
+    def _maybe_refresh_hints(self, group: int) -> None:
+        """Opportunistic hint sweep: only when the group has no cached
+        leader AND the last sweep is stale — a warm cache costs
+        nothing, and 421 hints keep it warm between sweeps."""
+        with self._mu:
+            if group in self._leader or (
+                    time.monotonic() - self._hints_at
+                    < self.hint_refresh_s):
+                return
+        self.refresh_hints(timeout_s=0.5)
+
+    def _lease_target(self, group: int) -> Optional[int]:
+        """Node index holding a still-live lease hint for `group`, or
+        None.  Hints are short (the engine caps published deadlines at
+        its lease horizon) — an expired hint is simply ignored."""
+        with self._mu:
+            hint = self._lease.get(group)
+        if hint is not None and time.monotonic() < hint[1]:
+            return hint[0]
+        return None
 
     def _note_leader(self, group: int, headers: dict) -> bool:
         """Record a 421's X-Raft-Leader hint.  Returns True when the
@@ -271,6 +345,8 @@ class RaftSQLClient:
         deadline = time.monotonic() + deadline_s
         attempt = 0
         last: object = None
+        if node is None:
+            self._maybe_refresh_hints(group)
         while True:
             for idx in self._order(group, node):
                 try:
@@ -332,8 +408,14 @@ class RaftSQLClient:
         deadline = time.monotonic() + deadline_s
         attempt = 0
         last: object = None
+        if node is None:
+            self._maybe_refresh_hints(group)
         while True:
-            for idx in self._order(group, node):
+            # Linear reads chase the lease holder first: served there,
+            # the read needs no quorum round at all (lease fast path).
+            prefer = (self._lease_target(group)
+                      if consistency == "linear" else None)
+            for idx in self._order(group, node, prefer=prefer):
                 try:
                     status, hdrs, text = self.raw(
                         idx, "GET", "/", sql, headers)

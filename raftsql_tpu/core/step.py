@@ -76,11 +76,15 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         Values > 1 apply several intervals of advance at once — the host
         elides steps while nothing can fire (info.timer_margin) and
         settles the accumulated advance on the next real step.
-      force_bcast: scalar bool — leaders broadcast an append/heartbeat
-        round THIS step regardless of heartbeat countdown.  The host
-        sets it when a linearizable read registers (runtime/node.py
-        read_index): the ReadIndex quorum round must not wait out the
-        heartbeat interval.
+      force_bcast: scalar bool OR [G] bool — leaders broadcast an
+        append/heartbeat round THIS step regardless of heartbeat
+        countdown.  The host sets it when a linearizable read
+        registers (runtime/node.py read_index / read_join): the
+        ReadIndex quorum round must not wait out the heartbeat
+        interval.  A [G] mask (the batched-ReadIndex promote,
+        runtime/node.py _rb_promote) nudges only the groups with
+        reads in flight; it broadcasts against the same [G] hb_fire
+        vector a scalar does, so semantics per group are identical.
 
     Returns:
       (new_state, outbox, info).  `outbox[g, dst]` is the dense message set

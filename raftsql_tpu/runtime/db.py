@@ -28,6 +28,7 @@ sentinel) to tests — the reference's `commitListenerC` observability hook
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import time
@@ -42,6 +43,8 @@ from raftsql_tpu.runtime.node import (CLOSED, RAW_BATCH, RAW_MANY,
                                       RAW_PLAIN)
 from raftsql_tpu.runtime.pipe import RaftPipe
 from raftsql_tpu.utils.metrics import LatencyTimer
+
+log = logging.getLogger("raftsql_tpu.db")
 
 
 def iter_plain_entries(base, datas):
@@ -243,6 +246,12 @@ class RaftDB:
         # callable whose dict is merged into metrics() — ring depth,
         # proposed/completed counts of the multi-worker deployment.
         self.serving_metrics = None
+        # Shared-memory snapshot publisher (runtime/shm.py), attached
+        # by RingServer when the worker read fast path is on: every
+        # applied run is mirrored into the worker-mapped snapshot log
+        # (publish_deltas), snapshot installs republish the group's
+        # base image.  None keeps the apply path untouched.
+        self.shm = None
         # Placement controller (raftsql_tpu/placement/), attached by
         # the server's --placement flag; None keeps metrics() and
         # flight bundles unchanged.
@@ -313,6 +322,19 @@ class RaftDB:
                 errs[group] = batch_fn(items)
             else:
                 errs[group] = [sm.apply(qy, ix) for (qy, ix) in items]
+        if self.shm is not None:
+            # Mirror the applied run into the worker-mapped snapshot
+            # log BEFORE acks fire: a client whose PUT just acked may
+            # immediately session-read at a worker, and the worker's
+            # replica must be able to reach that watermark.  Statements
+            # that errored are published too — workers re-apply them
+            # under the same SAVEPOINT semantics, so replica state
+            # stays bit-identical to the engine's.
+            try:
+                self.shm.publish_deltas(per_g)
+            except Exception:                           # noqa: BLE001
+                log.exception("shm delta publish failed; disabling")
+                self.shm = None
         tracer = self._node_tracer()
         pos = {g: 0 for g in per_g}
         for (group, index, query) in run:
@@ -405,6 +427,15 @@ class RaftDB:
     def _install_snapshot(self, group: int, index: int,
                           blob: bytes) -> None:
         self._sms[group].install(blob, index)
+        if self.shm is not None:
+            # A state transfer skipped the delta stream: workers must
+            # rebuild their replica from the installed image, so the
+            # group's base is republished into the snapshot log.
+            try:
+                self.shm.publish_base(group, blob, index)
+            except Exception:                           # noqa: BLE001
+                log.exception("shm base publish failed; disabling")
+                self.shm = None
         # A state transfer SKIPS the log: proposals whose commits sit
         # INSIDE the snapshot are never published here, so their acks
         # would wait forever (the reference never snapshots and inherits
@@ -606,6 +637,30 @@ class RaftDB:
                 m.lease_degrades += 1
         if m is not None:
             m.reads_read_index += 1
+        join_fn = getattr(node, "read_join", None)
+        if join_fn is not None:
+            # Batched ReadIndex (runtime/node.py): join the group's
+            # shared per-tick round and sleep on its event — N
+            # concurrent readers cost one quorum round per tick, and
+            # nobody poll-spins at tick cadence.
+            while True:
+                b = join_fn(group)
+                if b is None:
+                    raise NotLeaderError(group,
+                                         node.leader_of(group) + 1)
+                b.evt.wait(max(deadline - time.monotonic(), 0.0))
+                if b.status == "ok":
+                    self._wait_applied(group, b.target, deadline,
+                                       tick, "apply")
+                    return
+                if time.monotonic() > deadline:
+                    raise ReadTimeout(
+                        group, "confirm",
+                        "leadership not re-confirmed "
+                        "(no quorum reachable?)")
+                # "not_leader" (or spurious wake): re-join — once the
+                # role cache reflects the loss, join returns None and
+                # the typed redirect surfaces.
         while True:
             got = node.read_index(group)
             if got is None:
@@ -764,10 +819,18 @@ class RaftDB:
                      "leader": int(node.leader_of(g)) + 1
                      if hasattr(node, "leader_of") else 0}
             for g in range(self.num_groups)}
+        # Routing hints (PR 12, api/client.py front router): per-group
+        # remaining lease seconds — a client routes linearizable reads
+        # to the node reporting a live lease, writes to the leader.
+        lease_fn = getattr(node, "lease_deadline_s", None)
+        now = time.monotonic()
         for g in range(self.num_groups):
             row = groups.get(str(g))
             if row is not None:
                 row["applied"] = int(self._sms[g].applied_index())
+                if lease_fn is not None:
+                    row["lease_s"] = round(
+                        max(lease_fn(g) - now, 0.0), 4)
         return {"id": int(getattr(node, "node_id", 0)),
                 "ready": True, "groups": groups}
 

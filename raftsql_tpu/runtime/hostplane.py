@@ -50,6 +50,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -921,6 +922,55 @@ class ClusterHostPlane:
 
     def read_ready(self, group: int, reg_tick: int) -> bool:
         return True
+
+    def status(self) -> dict:
+        """Per-group consensus status for GET /healthz (same shape as
+        runtime/node.py status()): in the co-located cluster the
+        process's role for a group is "leader" once a leader is known
+        — every peer lives here — and "unknown" while leaderless.
+        Host caches only (hints + hard-state mirror); never touches
+        device arrays."""
+        out = {}
+        for g in range(self.cfg.num_groups):
+            p = int(self._hints[g])
+            if p >= 0:
+                out[str(g)] = {"role": "leader", "leader": p + 1,
+                               "term": int(self._hard[p, g, 0]),
+                               "commit": int(self._hard[p, g, 2])}
+            else:
+                out[str(g)] = {"role": "unknown", "leader": 0,
+                               "term": 0, "commit": 0}
+        return out
+
+    # Published-deadline horizon: see runtime/node.py — the shm
+    # publisher refreshes every millisecond or two, so capping how far
+    # ahead a deadline reaches bounds staleness when the tick loop
+    # hot-spins device steps faster than the wall interval.
+    _LEASE_HORIZON_S = 0.05
+
+    def lease_deadline_s(self, group: int) -> float:
+        """The time.monotonic() instant until which a lease read for
+        `group` stays provably safe, 0.0 when no live lease — the
+        shm-snapshot / routing-hint surface (runtime/shm.py).  The
+        remaining lease is measured in DEVICE steps against the same
+        `_device_steps + max_clock_skew` bound lease_read enforces, so
+        a mis-sized max_clock_skew propagates verbatim into the
+        published deadline (the chaos falsification pair still
+        catches it on the shm plane).  No metric side effects."""
+        cfg = self.cfg
+        if cfg.lease_ticks <= 0:
+            return 0.0
+        lc = self._lease_col
+        p = int(self._hints[group])
+        if lc is None or p < 0:
+            return 0.0
+        until = int(lc[p, group])
+        remaining = until - (self._device_steps + cfg.max_clock_skew)
+        if until <= 0 or remaining <= 0:
+            return 0.0
+        interval = max(cfg.tick_interval_s, 1e-4)
+        return time.monotonic() + min(remaining * interval,
+                                      self._LEASE_HORIZON_S)
 
     # -- the tick -------------------------------------------------------
 

@@ -118,6 +118,27 @@ def _view_info(ginfo: np.ndarray, next_idx: np.ndarray) -> _PackedView:
 # form is the only one whose payloads still need envelope unwrap/dedup,
 # so it is tagged explicitly rather than sniffed by payload type.
 RAW_BATCH = object()
+
+
+class _ReadBatch:
+    """One group's worth of ReadIndex registrations sharing ONE quorum
+    round (RaftNode.read_join).  Client threads join the group's
+    pending batch and wait on `evt`; the tick thread stamps (target,
+    term, reg) when it promotes the batch into the tick's broadcast,
+    and whichever thread first observes the quorum (tick tail or a
+    transport delivery) publishes `status` and fires the event."""
+
+    __slots__ = ("group", "count", "target", "term", "reg", "status",
+                 "evt")
+
+    def __init__(self, group: int):
+        self.group = group
+        self.count = 0          # joined readers (metrics batch size)
+        self.target = -1        # commit index the batch reads at
+        self.term = -1          # leader term the round must confirm
+        self.reg = -1           # registration tick (round seq binding)
+        self.status = ""        # "" pending | "ok" | "not_leader"
+        self.evt = threading.Event()
 # Same shape, but payloads are PLAIN bytes — no dedup envelopes (the
 # fused/mesh runtimes route proposals on the host and never wrap).
 # Expansion skips the per-entry unwrap probe, which is a measurable
@@ -354,8 +375,23 @@ class RaftNode:
         # read_index so the ReadIndex confirm round goes out on the next
         # step instead of the next heartbeat.  Benign race: a lost
         # concurrent set only delays the round to the heartbeat.
+        # ALWAYS shipped as a [G] bool mask — the batched-ReadIndex
+        # promote narrows the nudge per group, and keeping one dtype
+        # from the very first tick means one jit entry: a mid-flight
+        # scalar->mask switch would recompile the step UNDER the
+        # leader's election timer and depose it.
         self._force_bcast = False
-        self._fb_arr = (jnp.asarray(False), jnp.asarray(True))
+        self._fb_arr = (jnp.zeros(G, bool), jnp.ones(G, bool))
+        # Batched ReadIndex (PR 12): client threads join a per-group
+        # pending batch (read_join); the tick thread promotes every
+        # pending batch into ONE shared quorum round — the broadcast the
+        # tick already fires — so N concurrent linearizable reads cost
+        # one round per tick instead of one round each.  _rb_pending
+        # holds the batch joiners may still enter; _rb_active holds
+        # promoted batches awaiting their round's quorum of echoes.
+        self._rb_lock = threading.Lock()
+        self._rb_pending: Dict[int, _ReadBatch] = {}
+        self._rb_active: Dict[int, List[_ReadBatch]] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -395,6 +431,7 @@ class RaftNode:
         self._stopped = True
         self._stop_evt.set()
         self._work_evt.set()     # wake a margin-length idle sleep NOW
+        self._rb_abort_all()     # unblock batched readers immediately
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.transport.stop()
@@ -408,6 +445,7 @@ class RaftNode:
         self.error = err
         self._stop_evt.set()
         self._work_evt.set()     # wake a margin-length idle sleep NOW
+        self._rb_abort_all()     # unblock batched readers immediately
         self.commit_q.put(CLOSED)
 
     # ------------------------------------------------------------------
@@ -729,11 +767,11 @@ class RaftNode:
         on (X-Raft-Session).  Host cache only; safe from any thread."""
         return int(self._hard_np[group, 2])
 
-    def lease_read(self, group: int) -> Optional[int]:
-        """Serve a linearizable read from the leader lease: returns the
-        read's target commit index, or None when no valid lease covers
-        `now + max_clock_skew` (the caller degrades to the ReadIndex
-        round — never a silent stale read).
+    def _lease_eval(self, group: int) -> Optional[Tuple[int, int]]:
+        """(commit, remaining_ticks) of this node's leader lease for
+        `group`, or None when no lease can be proved at all (leases
+        disabled, not leader, §6.4 current-term-commit precondition
+        pending).  remaining_ticks <= 0 means the lease has lapsed.
 
         The lease: each peer's newest seq echo at our current term
         names the newest round it confirmed; mapping seqs to the lease
@@ -741,8 +779,8 @@ class RaftNode:
         the latest clock c at which a full quorum had confirmed our
         leadership (and, by the Phase-8 reset + prevote in-lease rule,
         cannot grant an election probe before c + election_ticks of
-        its own clock).  Requires the §6.4 current-term-commit
-        precondition exactly like read_index."""
+        its own clock).  Validity bound: now + max_clock_skew <
+        c + lease_ticks."""
         cfg = self.cfg
         if cfg.lease_ticks <= 0 or self._last_role[group] != LEADER:
             return None
@@ -773,11 +811,49 @@ class RaftNode:
             q = mm.quorum_nth(group, clocks)
         else:
             q = int(np.sort(clocks)[self.num_nodes - cfg.quorum])
-        if now + cfg.max_clock_skew < q + cfg.lease_ticks:
+        return commit, (q + cfg.lease_ticks) - (now + cfg.max_clock_skew)
+
+    def lease_read(self, group: int) -> Optional[int]:
+        """Serve a linearizable read from the leader lease: returns the
+        read's target commit index, or None when no valid lease covers
+        `now + max_clock_skew` (the caller degrades to the ReadIndex
+        round — never a silent stale read)."""
+        ev = self._lease_eval(group)
+        if ev is None:
+            return None
+        commit, remaining = ev
+        if remaining > 0:
             self.metrics.lease_grants += 1
             return commit
         self.metrics.lease_expiries += 1
         return None
+
+    # Cap on how far ahead a published lease deadline may reach: the
+    # shm publisher refreshes every millisecond or two, so a short
+    # horizon costs no availability while bounding how stale a mapped
+    # deadline can be if tick pacing stalls right after a publish.
+    _LEASE_HORIZON_S = 0.05
+
+    def lease_deadline_s(self, group: int) -> float:
+        """The time.monotonic() instant until which a lease read for
+        `group` is provably safe, or 0.0 when no live lease.  This is
+        the routing-hint / shm-snapshot surface (runtime/shm.py): the
+        remaining lease ticks — already net of max_clock_skew, the
+        same bound lease_read enforces — convert to wall time at the
+        configured tick interval, capped at _LEASE_HORIZON_S.
+        CLOCK_MONOTONIC is system-wide on Linux, so worker processes
+        compare the published deadline against their own clock.  No
+        metric side effects (this is a telemetry probe, not a served
+        read)."""
+        ev = self._lease_eval(group)
+        if ev is None:
+            return 0.0
+        _commit, remaining = ev
+        if remaining <= 0:
+            return 0.0
+        interval = max(self.cfg.tick_interval_s, 1e-4)
+        return time.monotonic() + min(remaining * interval,
+                                      self._LEASE_HORIZON_S)
 
     def read_index(self, group: int):
         """Register a linearizable read.
@@ -833,6 +909,122 @@ class RaftNode:
             # Mask-weighted confirmation (joint: both majorities).
             return mm.quorum_confirmed(group, ok, self.self_id)
         return int(ok.sum()) + 1 >= self.cfg.quorum
+
+    # ------------------------------------------------------------------
+    # batched ReadIndex (PR 12): all linearizable reads registered
+    # between two ticks share the ONE broadcast round the next tick
+    # fires, so quorum cost is per-tick, not per-read.
+
+    def read_join(self, group: int) -> Optional[_ReadBatch]:
+        """Join the group's pending ReadIndex batch.  Returns a
+        _ReadBatch whose `evt` fires once the shared round resolves —
+        status "ok" with `target` the commit index to wait on, or
+        "not_leader" (re-join or redirect via leader_of).  Returns
+        None when this node does not currently lead the group.
+
+        Unlike read_index, no commit snapshot is taken here: the tick
+        thread stamps the batch's target at promotion, where commit
+        state is frozen (commits only advance on that thread) and the
+        confirming round is sent strictly afterwards."""
+        if self._last_role[group] != LEADER:
+            return None
+        with self._rb_lock:
+            b = self._rb_pending.get(group)
+            if b is None:
+                b = _ReadBatch(group)
+                self._rb_pending[group] = b
+            b.count += 1
+        self._work_evt.set()     # promote on a prompt tick, not a timer
+        return b
+
+    def _rb_finish(self, b: _ReadBatch, status: str) -> bool:
+        """Claim + publish a batch outcome exactly once; False when
+        another thread already resolved it (the tick tail and transport
+        deliveries race — metrics must count each batch once)."""
+        with self._rb_lock:
+            if b.status:
+                return False
+            b.status = status
+            if self._rb_pending.get(b.group) is b:
+                del self._rb_pending[b.group]
+            lst = self._rb_active.get(b.group)
+            if lst is not None:
+                try:
+                    lst.remove(b)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._rb_active[b.group]
+        b.evt.set()
+        return True
+
+    def _rb_promote(self) -> List[int]:
+        """Promote pending batches into this tick's broadcast (tick
+        thread ONLY, before the device step: commits advance only on
+        this thread, so the (term, commit) snapshot below is frozen,
+        and this tick's round — seq = _tick_no — is sent strictly
+        after it; that ordering is what makes reg = _tick_no a sound
+        registration).  Returns every group whose broadcast must fire
+        this tick: freshly promoted batches, batches still waiting on
+        the §6.4 no-op, and active batches re-nudged against loss."""
+        with self._rb_lock:
+            pend = dict(self._rb_pending)
+            groups = set(self._rb_active)
+        for g, b in pend.items():
+            if self._last_role[g] != LEADER:
+                self._rb_finish(b, "not_leader")
+                continue
+            term = int(self._hard_np[g, 0])
+            commit = int(self._hard_np[g, 2])
+            if commit < 1 \
+                    or self.payload_log.try_term_of(g, commit) != term:
+                # §6.4 precondition pending: keep the batch joinable —
+                # the round this tick fires replicates the no-op whose
+                # commit clears the precondition for a later promote.
+                groups.add(g)
+                continue
+            with self._rb_lock:
+                if b.status:
+                    continue
+                if self._rb_pending.get(g) is b:
+                    del self._rb_pending[g]     # cut off new joiners
+                b.target = commit
+                b.term = term
+                b.reg = self._tick_no
+                self._rb_active.setdefault(g, []).append(b)
+            groups.add(g)
+        return sorted(groups)
+
+    def _rb_resolve(self) -> None:
+        """Resolve active batches whose round completed: called from
+        the tick tail and from _deliver (a peer echo may complete the
+        quorum between ticks).  Never called under _stage_lock —
+        read_ready re-takes it."""
+        with self._rb_lock:
+            if not self._rb_active:
+                return
+            items = [b for bs in self._rb_active.values() for b in bs]
+        m = self.metrics
+        for b in items:
+            if b.status:
+                continue
+            g = b.group
+            if self._last_role[g] != LEADER \
+                    or int(self._hard_np[g, 0]) != b.term:
+                self._rb_finish(b, "not_leader")
+            elif self.read_ready(g, b.reg):
+                if self._rb_finish(b, "ok"):
+                    m.reads_read_index_batched += b.count
+                    m.note_read_batch(b.count)
+
+    def _rb_abort_all(self) -> None:
+        """Fail every outstanding batch (node stopping): waiting client
+        threads must unblock now, not at their deadlines."""
+        with self._rb_lock:
+            batches = list(self._rb_pending.values()) \
+                + [b for bs in self._rb_active.values() for b in bs]
+        for b in batches:
+            self._rb_finish(b, "not_leader")
 
     # ------------------------------------------------------------------
     # log compaction (snapshot-resume mode, SURVEY.md §5.4 improvement)
@@ -986,6 +1178,11 @@ class RaftNode:
                         self._props[pr.group].append(pr.payload)
                         self._prop_len[pr.group] += 1
                         self._fwd_groups.add(pr.group)
+        # This delivery may have carried the echo that completes an
+        # active read batch's quorum — resolve NOW (sub-tick read
+        # latency), outside _stage_lock (read_ready re-takes it).
+        if self._rb_active:
+            self._rb_resolve()
         self._work_evt.set()
 
     # ------------------------------------------------------------------
@@ -1110,14 +1307,29 @@ class RaftNode:
         t0 = time.monotonic()
         m.t_stage_ms += (t0 - ts) * 1e3
 
+        # Promote pending ReadIndex batches into this tick's round and
+        # build the force-broadcast [G] mask: the legacy whole-node
+        # nudge (read_index) broadcasts everywhere — bitwise what the
+        # old scalar True did — while batch work narrows the nudge to
+        # just the groups with reads in flight.  The idle path reuses
+        # the cached all-False mask: no per-tick allocation, and the
+        # step's trajectory is bit-identical to the pre-batcher code.
+        rb_groups = self._rb_promote() \
+            if (self._rb_pending or self._rb_active) else []
         fb = self._force_bcast
         if fb:
             self._force_bcast = False
+        if fb or not rb_groups:
+            fb_arg = self._fb_arr[fb]
+        else:
+            fb_mask = np.zeros(G, bool)
+            fb_mask[rb_groups] = True
+            fb_arg = jnp.asarray(fb_mask)
         state, pob, pinfo, nidx, margin = peer_step_packed(
             cfg, self.state, inbox, jnp.asarray(prop_n), self._self_arr,
             self._ti_arr[timer_inc] if timer_inc <= 1
             else jnp.asarray(timer_inc, jnp.int32),
-            self._fb_arr[fb])
+            fb_arg)
         self.state = state
         pob, pinfo, nidx, margin = jax.device_get(
             (pob, pinfo, nidx, margin))
@@ -1148,6 +1360,12 @@ class RaftNode:
         self._last_hint = np.asarray(info.leader_hint)
         self._tick_no += 1
         m.ticks += 1
+        # Resolve read batches against the freshest role/echo state:
+        # covers quorum=1 (read_ready is immediately true) and role
+        # loss; multi-node quorums usually resolve from _deliver when
+        # the round's echoes arrive.
+        if self._rb_active:
+            self._rb_resolve()
         # Re-arm the loop when a leader still has proposal backlog past
         # the per-step E cap (progress was made, more to drain now); a
         # leaderless backlog must NOT spin — it drains once election

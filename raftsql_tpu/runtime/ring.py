@@ -59,12 +59,15 @@ nothing else parses these):
 from __future__ import annotations
 
 import json
+import logging
 import mmap
 import os
 import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("raftsql_tpu.ring")
 
 _MAGIC = 0x52494E47                   # "RING"
 _HDR = 64                             # file header bytes
@@ -366,15 +369,62 @@ class RingServer:
         # profiler (obs/prof.py) when the engine exposes one.
         self._prof_node = getattr(getattr(rdb, "pipe", None), "node",
                                   None)
+        # Shared-memory snapshot plane (runtime/shm.py, PR 12): the
+        # read fast path workers map.  Attach the delta hook FIRST,
+        # then start() with base images — the ordering makes the
+        # published stream complete (shm.py start docstring).  Env
+        # gate RAFTSQL_SHM_READS=0 turns the plane off on both sides
+        # (chaos digest baselines run with it compiled in but idle).
+        self.shm = None
+        self._shm_thread = None
+        if os.environ.get("RAFTSQL_SHM_READS", "1") != "0" \
+                and hasattr(rdb, "_snapshot_of"):
+            try:
+                from raftsql_tpu.runtime.shm import ShmSnapshotPublisher
+                self.shm = ShmSnapshotPublisher(dirname, rdb.num_groups)
+                rdb.shm = self.shm
+                self.shm.start(rdb._snapshot_of, rdb.watermark)
+            except Exception:                           # noqa: BLE001
+                log.exception("shm snapshot plane disabled")
+                rdb.shm = None
+                self.shm = None
+        if self.shm is not None:
+            self._shm_thread = threading.Thread(
+                target=self._shm_refresh, daemon=True,
+                name="shm-refresh")
+
+    def _shm_refresh(self) -> None:
+        """Restamp the shm watermark/leader/lease columns from the
+        engine's host caches every couple of milliseconds — the
+        publisher heartbeat a worker's lease read requires to be
+        fresh (shm.py PUB_STALE_NS)."""
+        node = self._prof_node
+        commit_of = getattr(node, "commit_watermark", lambda g: 0)
+        leader_of = getattr(node, "leader_of", lambda g: -1)
+        lease_of = getattr(node, "lease_deadline_s", lambda g: 0.0)
+        while not self._stop.is_set():
+            try:
+                self.shm.refresh(commit_of, leader_of, lease_of)
+            except Exception:                           # noqa: BLE001
+                log.exception("shm refresh failed; stopping")
+                return
+            self._stop.wait(0.002)
 
     def start(self) -> None:
         for t in self._threads:
             t.start()
+        if self._shm_thread is not None:
+            self._shm_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        if self._shm_thread is not None:
+            self._shm_thread.join(timeout=5)
+        if self.shm is not None:
+            self.rdb.shm = None
+            self.shm.close()
         for r in self._req + self._cpl:
             r.close()
 
@@ -706,6 +756,19 @@ class RingClient:
             self._obs = TraceSegmentWriter(
                 dirname, f"http worker {worker}",
                 tag=f"w{worker}-{os.getpid()}")
+        # Shared-memory read fast path (runtime/shm.py, PR 12):
+        # best-effort attach — the engine creates the snapshot region
+        # before the rings, so if the map fails (gate off, older
+        # engine) every read simply takes the ring round trip.
+        self._shm = None
+        self._shm_hits = 0
+        self._shm_fallbacks = 0
+        if os.environ.get("RAFTSQL_SHM_READS", "1") != "0":
+            try:
+                from raftsql_tpu.runtime.shm import ShmSnapshotReader
+                self._shm = ShmSnapshotReader(dirname)
+            except Exception:                           # noqa: BLE001
+                self._shm = None
         self._consumer = threading.Thread(
             target=self._consume, daemon=True,
             name=f"ring-cpl-{worker}")
@@ -791,6 +854,8 @@ class RingClient:
         self._consumer.join(timeout=2)
         if self._obs is not None:
             self._obs.flush()       # the segment file outlives us
+        if self._shm is not None:
+            self._shm.close()
         self._req.close()
         self._cpl.close()
 
@@ -829,6 +894,25 @@ class RingClient:
                  "follower": 4}.get(mode)
         if flags is None:
             raise ValueError(f"unknown read mode {mode!r}")
+        if self._shm is not None:
+            # Zero-round-trip fast path: serve from the mapped
+            # snapshot when it PROVES this mode's freshness contract
+            # (shm.py module docstring); anything unprovable — stale
+            # epoch, uncovered watermark, lapsed lease, SQL error —
+            # falls through to the authoritative ring path below.
+            got = None
+            try:
+                got = self._shm.try_read(mode, group, query,
+                                         max(int(watermark), 0))
+            except Exception:                           # noqa: BLE001
+                self._shm = None       # a broken mapping is dead
+            if got is not None:
+                rows, wm = got
+                self._shm_hits += 1
+                if wm > self._wm.get(group, 0):
+                    self._wm[group] = wm
+                return rows
+            self._shm_fallbacks += 1
         fut = self._submit(OP_GET, group, flags,
                            max(int(watermark), 0),
                            query.encode("utf-8"))
@@ -875,15 +959,30 @@ class RingClient:
             raise RuntimeError(body.decode("utf-8", "replace"))
         return body.decode("utf-8")
 
+    def _inject_reads(self, doc: dict) -> dict:
+        """Fold this worker's shm fast-path counters into the engine's
+        metrics document (the engine's own shm_hits/shm_fallbacks are
+        always 0 — hits happen HERE).  Same mutation on both the JSON
+        and prom renders, so scripts/check_prom.py's round-trip check
+        stays exact."""
+        r = doc.setdefault("reads", {})
+        r["shm_hits"] = int(r.get("shm_hits", 0)) + self._shm_hits
+        r["shm_fallbacks"] = (int(r.get("shm_fallbacks", 0))
+                              + self._shm_fallbacks)
+        return doc
+
     def render_metrics(self) -> str:
-        return self._doc("metrics")
+        return json.dumps(
+            self._inject_reads(json.loads(self._doc("metrics"))),
+            sort_keys=True) + "\n"
 
     def render_metrics_prom(self) -> str:
         """Prometheus exposition at a worker: fetch the engine's JSON
         document over the ring and render locally — same mapping as
         RaftDB.render_metrics_prom, no new ring op."""
         from raftsql_tpu.utils.metrics import prom_render
-        return prom_render(json.loads(self._doc("metrics")))
+        return prom_render(
+            self._inject_reads(json.loads(self._doc("metrics"))))
 
     def render_health(self) -> str:
         return self._doc("health")

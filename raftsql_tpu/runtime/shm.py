@@ -1,0 +1,455 @@
+"""Shared-memory snapshot plane: worker-mapped read fast path (PR 12).
+
+The `--workers N` deployment (runtime/ring.py) moved HTTP parsing out
+of the engine process, but every GET still paid a full mmap-ring round
+trip INTO the engine: REQ slot, engine-side read pool, CPL slot.  The
+PR 9 reads ladder put that cost at the top of the read profile — even
+a `local` read, which touches no consensus state at all, crossed the
+ring twice.
+
+This module removes the round trip for the four read modes whose
+freshness evidence is DATA, not a quorum round: the engine publishes
+each group's applied SQL delta stream plus the `[G]` commit-watermark,
+leader and lease columns into one mmap'd file in the ring directory;
+workers map it READ-ONLY, feed per-group in-memory SQLite replicas
+from the delta log, and serve
+
+  * `local`    — replica catch-up to the published applied index;
+  * `session`  — only once the published applied index covers the
+    client's X-Raft-Session watermark (else fall back to the ring,
+    where the engine blocks authoritatively);
+  * `follower` — only once published applied covers published commit;
+  * `linear`   — only while the published lease deadline (stamped by
+    the engine from the SAME `now + max_clock_skew` bound its own
+    lease reads enforce, runtime/node.py lease_deadline_s) covers the
+    worker's CLOCK_MONOTONIC now (system-wide on Linux, so the
+    deadline transfers across processes verbatim)
+
+entirely inside the worker process.  Anything not provable from the
+mapping — stale publisher heartbeat, watermark not yet covered, lease
+lapsed, log overflow, epoch mismatch — FAILS CLOSED to the ring path:
+the fast path may only ever skip work, never weaken a mode's contract.
+
+Concurrency design
+------------------
+
+One writer (the engine's apply thread + a refresh thread, serialized
+by a lock), many reader processes.  The header + per-group table are
+guarded by a SEQLOCK: the writer bumps `seq` to odd, writes, bumps to
+even; a reader snapshots seq, copies, re-checks (retry on odd/changed).
+The delta log is APPEND-ONLY and never rewritten below `log_head`, so
+readers copy log bytes WITHOUT the seqlock — a torn table read retries
+in microseconds, while log consumption can never livelock behind a
+fast writer.  When the log fills, the writer sets the `log_full` flag
+and stops publishing deltas; readers treat the region as permanently
+dead and every read falls back (the engine keeps serving via the
+ring).  A restarted engine draws a fresh random `epoch`: a worker
+whose mapping no longer matches its attached epoch marks the plane
+dead — remapping a new region mid-flight could alias a rolled-back
+applied index, so restart recovery is deliberately NOT transparent
+(ISSUE 12: stale-epoch remap must fail closed).
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import secrets
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Header: magic, version, flags, num_groups, epoch, seq, log_head,
+# log_cap, pub_ns.  64 bytes with padding to keep the group table
+# aligned.
+_MAGIC = 0x534E4150                      # "SNAP"
+_VERSION = 1
+_FLAG_LOG_FULL = 1
+_HDR = struct.Struct("<IHHIQQQQQ")       # 48 bytes used
+_HDR_SIZE = 64
+# Per-group row: applied, commit, base_index, lease_deadline_ns,
+# leader (1-based, 0 unknown), pad.
+_ROW = struct.Struct("<QQQQIi")
+_ROW_SIZE = _ROW.size                    # 40 bytes
+# Log record header: length of payload, kind, group, index.
+_REC = struct.Struct("<IBIQ")
+KIND_DELTA = 1                           # payload = one SQL statement
+KIND_BASE = 2                            # payload = serialized image
+
+SHM_FILE = "snap.shm"
+DEFAULT_BYTES = 32 << 20
+
+# A mapping whose publisher heartbeat is older than this is treated as
+# dead for LEASE reads only: local/session/follower freshness is
+# proven by the watermarks themselves, but a lease deadline published
+# by a wedged engine must not outlive the engine's own refresh cadence
+# by much more than the lease horizon.
+PUB_STALE_NS = 250_000_000
+
+
+def shm_path(ring_dir: str) -> str:
+    return os.path.join(ring_dir, SHM_FILE)
+
+
+class ShmSnapshotPublisher:
+    """Engine side: owns the mapping read-write, publishes base images,
+    applied deltas and the watermark/lease/leader table.
+
+    publish_deltas runs on the apply thread (runtime/db.py _apply_run,
+    before acks fire — a worker can then always reach an acked PUT's
+    watermark); refresh() runs on a short-interval thread owned by the
+    RingServer and restamps commit/leader/lease columns + the
+    publisher heartbeat."""
+
+    def __init__(self, ring_dir: str, num_groups: int,
+                 size: Optional[int] = None):
+        size = size or int(os.environ.get("RAFTSQL_SHM_BYTES",
+                                          DEFAULT_BYTES))
+        self.num_groups = num_groups
+        self._table_off = _HDR_SIZE
+        self._log_off = _HDR_SIZE + num_groups * _ROW_SIZE
+        size = max(size, self._log_off + (1 << 20))
+        self.path = shm_path(ring_dir)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR | os.O_TRUNC,
+                     0o600)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._size = size
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._log_head = 0
+        self._log_cap = size - self._log_off
+        self._full = False
+        self.epoch = secrets.randbits(63) | 1    # never 0
+        self._rows = [[0, 0, 0, 0, 0] for _ in range(num_groups)]
+        #             applied, commit, base_index, lease_ns, leader
+        # Deltas arriving before start() buffer here: the log must
+        # open with each group's base image so a replica can never
+        # replay a delta stream whose prefix it is missing.
+        self._pending: Optional[List[Dict[int, list]]] = []
+        self._write_header(pub_ns=time.monotonic_ns())
+        self._write_table()
+
+    # -- writer internals (callers hold _lock) --------------------------
+
+    def _write_header(self, pub_ns: int) -> None:
+        flags = _FLAG_LOG_FULL if self._full else 0
+        self._mm[0:_HDR.size] = _HDR.pack(
+            _MAGIC, _VERSION, flags, self.num_groups, self.epoch,
+            self._seq, self._log_head, self._log_cap, pub_ns)
+
+    def _write_table(self) -> None:
+        off = self._table_off
+        for row in self._rows:
+            self._mm[off:off + _ROW_SIZE] = _ROW.pack(
+                row[0], row[1], row[2], row[3], row[4], 0)
+            off += _ROW_SIZE
+
+    def _publish_locked(self, writes: Callable[[], None]) -> None:
+        """Seqlock write protocol: odd → mutate → even.  The log bytes
+        appended by `writes` land BEFORE the header's log_head moves —
+        readers never see a head past initialized bytes."""
+        self._seq += 1                       # odd: writer in critical
+        self._write_header(pub_ns=time.monotonic_ns())
+        writes()
+        self._seq += 1                       # even: consistent again
+        self._write_header(pub_ns=time.monotonic_ns())
+
+    def _append_locked(self, kind: int, group: int, index: int,
+                       payload: bytes) -> bool:
+        need = _REC.size + len(payload)
+        if self._log_head + need > self._log_cap:
+            self._full = True
+            return False
+        off = self._log_off + self._log_head
+        self._mm[off:off + _REC.size] = _REC.pack(
+            len(payload), kind, group, index)
+        self._mm[off + _REC.size:off + need] = payload
+        self._log_head += need
+        return True
+
+    def _run_locked(self, per_g: Dict[int, list]) -> None:
+        """Append one applied run's deltas (caller holds _lock, inside
+        the seqlock critical section)."""
+        for group, items in per_g.items():
+            row = self._rows[group]
+            for (sql, index) in items:
+                if index <= row[0]:
+                    continue                 # covered by base/duplicate
+                if not self._append_locked(KIND_DELTA, group, index,
+                                           sql.encode("utf-8")):
+                    return
+                row[0] = index
+
+    # -- engine-facing API ----------------------------------------------
+
+    def start(self, serialize_of, applied_of) -> None:
+        """Open the log: one base image per group (serialize_of(g) →
+        (index, blob) or None), then every delta run buffered since
+        the publisher was attached.  The attach-then-start ordering
+        makes the stream complete: an apply finishing before its
+        group's serialize is inside the base; one finishing after is a
+        buffered delta ABOVE it (flushed here, in arrival order,
+        before direct appends begin).  A group that HAS applied state
+        (applied_of(g) > 0) but cannot produce an image would leave
+        replicas with a truncated stream — the whole plane fails
+        closed (log_full) rather than serve wrong prefixes."""
+        bases = {}
+        for g in range(self.num_groups):
+            got = serialize_of(g)
+            if got is not None and got[0] > 0:
+                bases[g] = got
+            elif int(applied_of(g)) > 0:
+                with self._lock:
+                    self._full = True
+                    self._pending = None
+                    self._publish_locked(lambda: None)
+                return
+        with self._lock:
+            def writes():
+                for g, (idx, blob) in bases.items():
+                    if self._append_locked(KIND_BASE, g, idx, blob):
+                        row = self._rows[g]
+                        row[0] = max(row[0], idx)
+                        row[2] = idx
+                for per_g in (self._pending or ()):
+                    self._run_locked(per_g)
+                self._write_table()
+            self._publish_locked(writes)
+            self._pending = None
+
+    def publish_base(self, group: int, blob: bytes, index: int) -> None:
+        """Publish a group's full serialized image (snapshot install).
+        Readers install the base when it passes their replica's applied
+        index and replay deltas above it."""
+        with self._lock:
+            if self._full:
+                return
+
+            def writes():
+                if self._append_locked(KIND_BASE, group, index, blob):
+                    row = self._rows[group]
+                    row[0] = max(row[0], index)
+                    row[2] = index
+                    self._write_table()
+            self._publish_locked(writes)
+
+    def publish_deltas(self, per_g: Dict[int, List[Tuple[str, int]]]
+                       ) -> None:
+        """Publish one applied run: per group, the (sql, index) items
+        just handed to the state machine, in apply order."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.append(per_g)
+                return
+            if self._full:
+                return
+
+            def writes():
+                self._run_locked(per_g)
+                self._write_table()
+            self._publish_locked(writes)
+
+    def refresh(self, commit_of, leader_of, lease_deadline_s) -> None:
+        """Restamp the watermark/leader/lease columns + heartbeat from
+        the engine's host caches (RingServer refresh thread).  Lease
+        deadlines convert monotonic seconds → ns; 0.0 stays 0 (no
+        lease)."""
+        with self._lock:
+            for g in range(self.num_groups):
+                row = self._rows[g]
+                try:
+                    row[1] = max(row[1], int(commit_of(g)))
+                    row[4] = int(leader_of(g)) + 1
+                    d = lease_deadline_s(g)
+                    row[3] = int(d * 1e9) if d > 0 else 0
+                except Exception:            # noqa: BLE001
+                    row[3] = 0               # fail closed, keep going
+            self._publish_locked(self._write_table)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._mm.close()
+            except (BufferError, ValueError):
+                pass
+
+    # test/diagnostic surface
+    @property
+    def log_full(self) -> bool:
+        return self._full
+
+
+class _GroupReplica:
+    """One group's in-process SQLite replica, fed from the delta log.
+    resume=True gives the state machine's own `index <= applied` skip,
+    so re-feeding an overlapping window is harmless."""
+
+    def __init__(self, group: int):
+        from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+        self.sm = SQLiteStateMachine(":memory:", resume=True)
+        self.group = group
+        self.consumed = 0        # log bytes already fed
+
+
+class ShmSnapshotReader:
+    """Worker side: maps the snapshot region read-only and serves
+    reads from per-group replicas.  Every public method FAILS CLOSED —
+    returns None — whenever the mapping cannot PROVE the mode's
+    freshness contract; the caller (runtime/ring.py RingClient) then
+    takes the ordinary ring round trip."""
+
+    def __init__(self, ring_dir: str):
+        self.path = shm_path(ring_dir)
+        fd = os.open(self.path, os.O_RDONLY)
+        try:
+            self._mm = mmap.mmap(fd, 0, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        self._lock = threading.Lock()
+        self._dead = False
+        hdr = self._read_header_raw()
+        if hdr is None or hdr[0] != _MAGIC or hdr[1] != _VERSION:
+            raise RuntimeError(f"{self.path}: bad snapshot header")
+        self.epoch = hdr[4]
+        self.num_groups = hdr[3]
+        self._table_off = _HDR_SIZE
+        self._log_off = _HDR_SIZE + self.num_groups * _ROW_SIZE
+        self._replicas: Dict[int, _GroupReplica] = {}
+
+    # -- mapping access -------------------------------------------------
+
+    def _read_header_raw(self):
+        try:
+            return _HDR.unpack(self._mm[0:_HDR.size])
+        except (ValueError, struct.error):
+            return None
+
+    def _snapshot_table(self):
+        """Seqlock read of header + group table: (header, rows) or
+        None after bounded retries / on any fail-closed condition.
+        The epoch check pins the attachment: a restarted engine's
+        fresh region (new epoch) permanently kills this reader."""
+        if self._dead:
+            return None
+        for _ in range(64):
+            h1 = self._read_header_raw()
+            if h1 is None:
+                return None
+            if h1[0] != _MAGIC or h1[1] != _VERSION \
+                    or h1[4] != self.epoch:
+                self._dead = True            # stale epoch: fail closed
+                return None
+            if h1[5] & 1:                    # writer mid-update
+                time.sleep(0)
+                continue
+            raw = bytes(self._mm[self._table_off:self._log_off])
+            h2 = self._read_header_raw()
+            if h2 is None or h2[5] != h1[5] or h2[4] != self.epoch:
+                time.sleep(0)
+                continue                     # torn: retry
+            rows = [_ROW.unpack_from(raw, i * _ROW_SIZE)
+                    for i in range(self.num_groups)]
+            return h1, rows
+        return None
+
+    def _catch_up(self, rep: _GroupReplica, target: int,
+                  log_head: int) -> bool:
+        """Feed the replica from the append-only log until its applied
+        index reaches `target`.  Log bytes below log_head are immutable
+        — no seqlock needed here.  False when the log ran out before
+        the target (publisher hasn't written it yet — fall back)."""
+        g = rep.group
+        while rep.sm.applied_index() < target:
+            if rep.consumed + _REC.size > log_head:
+                return False
+            off = self._log_off + rep.consumed
+            ln, kind, group, index = _REC.unpack(
+                self._mm[off:off + _REC.size])
+            if rep.consumed + _REC.size + ln > log_head:
+                return False
+            payload = bytes(self._mm[off + _REC.size:
+                                     off + _REC.size + ln])
+            rep.consumed += _REC.size + ln
+            if group != g:
+                continue
+            if kind == KIND_BASE:
+                if index > rep.sm.applied_index():
+                    rep.sm.install(payload, index)
+            elif kind == KIND_DELTA:
+                # resume-mode state machine skips index <= applied.
+                rep.sm.apply(payload.decode("utf-8"), index)
+        return True
+
+    # -- read API --------------------------------------------------------
+
+    def try_read(self, mode: str, group: int, query: str,
+                 watermark: int = 0
+                 ) -> Optional[Tuple[str, int]]:
+        """Serve one read entirely from the mapping: (rows, session
+        watermark echo) — or None to fall back to the ring.  `mode` is
+        local/session/follower/linear with the contracts documented in
+        the module docstring."""
+        from raftsql_tpu.models.sqlite_sm import is_select
+        if not is_select(query):
+            return None          # engine's 400 class — and NEVER let a
+            #                      write mutate the worker-side replica
+        snap = self._snapshot_table()
+        if snap is None:
+            return None
+        hdr, rows = snap
+        if hdr[2] & _FLAG_LOG_FULL:
+            self._dead = True                # overflow: permanently out
+            return None
+        if not 0 <= group < self.num_groups:
+            return None
+        applied, commit, _base, lease_ns, _leader, _pad = rows[group]
+        if mode == "local":
+            target = applied
+        elif mode == "session":
+            if applied < watermark:
+                return None                  # engine blocks, we don't
+            target = max(applied, watermark)
+        elif mode == "follower":
+            if applied < commit:
+                return None
+            target = commit
+        elif mode == "linear":
+            if lease_ns <= 0 or time.monotonic_ns() >= lease_ns:
+                return None                  # no provable lease
+            if applied < commit:
+                return None
+            if time.monotonic_ns() - hdr[8] > PUB_STALE_NS:
+                return None                  # publisher heartbeat stale
+            target = commit
+        else:
+            return None
+        with self._lock:
+            rep = self._replicas.get(group)
+            if rep is None:
+                rep = _GroupReplica(group)
+                self._replicas[group] = rep
+            if not self._catch_up(rep, target, hdr[6]):
+                return None
+            try:
+                out = rep.sm.query(query)
+            except Exception:                # noqa: BLE001
+                return None                  # surface SQL errors via ring
+            return out, int(rep.sm.applied_index())
+
+    def leader_of(self, group: int) -> int:
+        """Published 1-based leader hint (0 unknown), for worker-side
+        421 redirects without a ring trip; -0 fail-open to 0."""
+        snap = self._snapshot_table()
+        if snap is None or not 0 <= group < self.num_groups:
+            return 0
+        return int(snap[1][group][4])
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass

@@ -109,7 +109,9 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                      compact_every: int = 0, compact_keep: int = 1024,
                      wal_segment_bytes: int = 4 << 20,
                      trace: bool = False,
-                     wal_group_commit: bool = True) -> RaftDB:
+                     wal_group_commit: bool = True,
+                     lease_ticks: int = 0,
+                     max_clock_skew: int = 1) -> RaftDB:
     """The --fused single-process deployment: all P peers of every
     group co-located in THIS process, consensus advanced by ONE fused
     device program per tick (runtime/fused.py), per-peer WALs on disk,
@@ -119,9 +121,19 @@ def build_fused_node(groups: int = 1, peers: int = 3,
     cross-process hops on the propose→commit path."""
     from raftsql_tpu.runtime.fused import FusedClusterNode, FusedPipe
 
+    # Leader leases on the fused plane: same safety clamp as
+    # build_node — an operator-supplied lease can never exceed what
+    # the (default) election timeout protects.
+    if lease_ticks:
+        election_default = RaftConfig.__dataclass_fields__[
+            "election_ticks"].default
+        lease_ticks = min(lease_ticks,
+                          max(1, election_default - max_clock_skew - 1))
     cfg = RaftConfig(num_groups=groups, num_peers=peers,
                      tick_interval_s=tick,
-                     wal_segment_bytes=wal_segment_bytes)
+                     wal_segment_bytes=wal_segment_bytes,
+                     lease_ticks=lease_ticks,
+                     max_clock_skew=max_clock_skew)
     # WAL group commit is the serving default: one write+fsync per tick
     # for all P peers (storage/wal.py GroupCommitWAL).  An existing
     # per-peer data dir keeps its layout (the host plane refuses to
@@ -386,7 +398,9 @@ def main(argv=None) -> None:
                                wal_segment_bytes=args.wal_segment_bytes,
                                trace=args.trace,
                                wal_group_commit=args.wal_group_commit
-                               == "on")
+                               == "on",
+                               lease_ticks=args.lease_ticks,
+                               max_clock_skew=args.max_clock_skew)
     else:
         rdb = build_node(args.cluster, args.id, groups=args.groups,
                          tick=args.tick, resume=args.resume,

@@ -54,6 +54,19 @@ class NodeMetrics:
     lease_grants: int = 0
     lease_expiries: int = 0
     lease_degrades: int = 0
+    # Zero-round-trip read plane (PR 12): shm_hits are GETs a worker
+    # served from its mapped snapshot without any ring traffic;
+    # shm_fallbacks are GETs that tried the shm plane and had to fall
+    # back to the ring round trip (stale epoch, publisher behind the
+    # requested watermark, log overflow); read_index_batched counts
+    # ReadIndex reads confirmed by a SHARED per-tick quorum round
+    # (runtime/node.py read batcher) rather than a round of their own.
+    # The batch histogram buckets how many reads each confirming round
+    # carried (power-of-2 buckets like transfer_stall_hist).
+    reads_shm_hits: int = 0
+    reads_shm_fallbacks: int = 0
+    reads_read_index_batched: int = 0
+    read_batch_hist: Dict[str, int] = field(default_factory=dict)
     # Fault counters (chaos/ harness + storage fsio shim): injected
     # message-plane faults and storage faults survived by this node.
     # Zero outside chaos runs; exported so a chaos'd deployment's
@@ -107,6 +120,15 @@ class NodeMetrics:
         k = str(b)
         self.transfer_stall_hist[k] = self.transfer_stall_hist.get(k, 0) + 1
 
+    def note_read_batch(self, n: int) -> None:
+        """Bucket one confirming round's ReadIndex batch size."""
+        b = 1
+        t = max(int(n), 1)
+        while b < t:
+            b <<= 1
+        k = str(b)
+        self.read_batch_hist[k] = self.read_batch_hist.get(k, 0) + 1
+
     def snapshot(self) -> dict:
         up = max(time.monotonic() - self.started_at, 1e-9)
         t = max(self.ticks, 1)
@@ -132,6 +154,10 @@ class NodeMetrics:
                 "lease_grants": self.lease_grants,
                 "lease_expiries": self.lease_expiries,
                 "lease_degrades": self.lease_degrades,
+                "shm_hits": self.reads_shm_hits,
+                "shm_fallbacks": self.reads_shm_fallbacks,
+                "read_index_batched": self.reads_read_index_batched,
+                "batch_hist": dict(self.read_batch_hist),
             },
             "faults": {
                 "dropped_msgs": self.faults_dropped_msgs,

@@ -204,7 +204,14 @@ def lint_url(host: str, port: int, label: str = "",
                        "raftsql_transfers_initiated",
                        "raftsql_transfers_completed",
                        "raftsql_transfers_aborted",
-                       "raftsql_transfers_refused") + extra_required
+                       "raftsql_transfers_refused",
+                       # PR 12 read fast path: present (0 on the
+                       # engine — hits land at workers) so dashboards
+                       # can rate() them unconditionally.
+                       "raftsql_reads_shm_hits",
+                       "raftsql_reads_shm_fallbacks",
+                       "raftsql_reads_read_index_batched",
+                       ) + extra_required
     for required in required_series:
         assert any(n == required for (n, _l) in samples), \
             f"{tag}: required series {required} absent"

@@ -9,6 +9,14 @@ ZERO errors and a req/s floor.
     python scripts/serving_smoke.py
     SMOKE_SECONDS=10 SMOKE_CLIENTS=32 SMOKE_MIN_RPS=200 ...
 
+`--reads` runs the READ-PLANE smoke instead (PR 12): the same
+deployment with leases on, interleaved PUTs and session GETs from
+concurrent clients, asserting (a) no session read ever answers below
+the client's own PUT watermark (read-your-writes across workers), and
+(b) the worker-mapped shared-memory fast path actually served reads
+(`reads.shm_hits > 0` in /metrics — the zero-round-trip plane is live,
+not silently falling back to the ring).
+
 Exit 0 on pass; 1 with a diagnostic (and the server log tail) on fail.
 """
 from __future__ import annotations
@@ -150,5 +158,122 @@ def main() -> int:
         logf.close()
 
 
+def reads_main() -> int:
+    """--reads: the zero-round-trip read-plane gate."""
+    groups = int(os.environ.get("SMOKE_GROUPS", "2"))
+    seconds = float(os.environ.get("SMOKE_SECONDS", "8"))
+    clients = int(os.environ.get("SMOKE_CLIENTS", "8"))
+    workers = int(os.environ.get("SMOKE_WORKERS", "2"))
+    port = free_port()
+    tmp = tempfile.mkdtemp(prefix="serving-smoke-reads-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(os.path.join(tmp, "server.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
+         "--workers", str(workers), "--groups", str(groups),
+         "--port", str(port), "--tick", "0.004",
+         "--lease-ticks", "6"],
+        cwd=tmp, env=env, stdout=logf, stderr=logf)
+
+    def fail(msg: str) -> int:
+        print(f"serving-smoke --reads: FAIL: {msg}", file=sys.stderr)
+        try:
+            with open(os.path.join(tmp, "server.log")) as f:
+                print(f.read()[-2000:], file=sys.stderr)
+        except OSError:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+        return 1
+
+    try:
+        from raftsql_tpu.api.client import RaftSQLClient
+        boot = RaftSQLClient([port], timeout_s=10)
+        boot.wait_healthy(0, deadline_s=120)
+        for g in range(groups):
+            boot.put("CREATE TABLE t (k INTEGER PRIMARY KEY, v text)",
+                     group=g, deadline_s=60)
+        boot.close()
+
+        client = RaftSQLClient([port], timeout_s=10,
+                               max_conns_per_node=clients + 4)
+        stats = {"puts": 0, "gets": 0, "stale": 0, "errors": 0}
+        mu = threading.Lock()
+        stop_at = time.monotonic() + seconds
+
+        def worker(ci: int) -> None:
+            g = ci % groups
+            session = 0
+            k = 0
+            while time.monotonic() < stop_at:
+                k += 1
+                try:
+                    wm = client.put(
+                        f"INSERT OR REPLACE INTO t VALUES "
+                        f"({ci * 1000000 + k}, 'v{k}')",
+                        group=g, deadline_s=10)
+                    if wm:
+                        session = max(session, wm)
+                    # A session read carrying my own PUT watermark must
+                    # never answer from below it — whichever worker,
+                    # whichever path (shm fast path or ring) serves it.
+                    rows, echo = client.get_session(
+                        "SELECT count(*) FROM t", group=g,
+                        consistency="session", session=session,
+                        deadline_s=10)
+                    with mu:
+                        stats["puts"] += 1
+                        stats["gets"] += 1
+                        if echo is not None and echo < session:
+                            stats["stale"] += 1
+                except Exception:                       # noqa: BLE001
+                    with mu:
+                        stats["errors"] += 1
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        status, _, text = client.raw(0, "GET", "/metrics")
+        m = json.loads(text) if status == 200 else {}
+        reads = m.get("reads", {})
+        client.close()
+        print(f"serving-smoke --reads: {stats['puts']} PUTs / "
+              f"{stats['gets']} session GETs, {stats['stale']} stale, "
+              f"{stats['errors']} errors; shm_hits="
+              f"{reads.get('shm_hits')} shm_fallbacks="
+              f"{reads.get('shm_fallbacks')}")
+        if stats["errors"]:
+            return fail(f"{stats['errors']} errored requests")
+        if stats["gets"] < clients:
+            return fail(f"only {stats['gets']} session reads ran")
+        if stats["stale"]:
+            return fail(f"{stats['stale']} session reads observed a "
+                        "watermark below the client's own PUT")
+        if not reads.get("shm_hits"):
+            return fail("reads.shm_hits == 0: the shared-memory fast "
+                        "path served nothing (scrape hit a worker "
+                        "whose mapping is dead, or the plane is off)")
+        proc.send_signal(signal.SIGTERM)
+        if proc.wait(timeout=30) != 0:
+            return fail(f"server exit code {proc.returncode}")
+        print("serving-smoke --reads: PASS")
+        return 0
+    except Exception as e:                              # noqa: BLE001
+        return fail(repr(e))
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:                           # noqa: BLE001
+                proc.kill()
+        logf.close()
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(reads_main() if "--reads" in sys.argv[1:] else main())

@@ -257,6 +257,12 @@ def test_ring_linear_get_421_redirect_workers_cluster(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # This test pins the RING read path (421 redirect, session echo,
+    # engine-side attribution) — the worker shm fast path would serve
+    # these reads before they ever cross the ring, so it stays off
+    # here (its own coverage: tests/test_shm.py + serving_smoke
+    # --reads).
+    env["RAFTSQL_SHM_READS"] = "0"
     procs = []
     for i in (0, 1):
         procs.append(subprocess.Popen(
