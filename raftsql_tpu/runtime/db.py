@@ -648,7 +648,17 @@ class RaftDB:
                 if b is None:
                     raise NotLeaderError(group,
                                          node.leader_of(group) + 1)
-                b.evt.wait(max(deadline - time.monotonic(), 0.0))
+                # A spurious wake on a still-pending batch must keep
+                # waiting on the SAME batch — re-joining would bump its
+                # count again and double-count this reader in
+                # reads_read_index_batched and the batch-size histogram.
+                while not b.status:
+                    if time.monotonic() > deadline:
+                        raise ReadTimeout(
+                            group, "confirm",
+                            "leadership not re-confirmed "
+                            "(no quorum reachable?)")
+                    b.evt.wait(max(deadline - time.monotonic(), 0.0))
                 if b.status == "ok":
                     self._wait_applied(group, b.target, deadline,
                                        tick, "apply")
@@ -658,9 +668,9 @@ class RaftDB:
                         group, "confirm",
                         "leadership not re-confirmed "
                         "(no quorum reachable?)")
-                # "not_leader" (or spurious wake): re-join — once the
-                # role cache reflects the loss, join returns None and
-                # the typed redirect surfaces.
+                # "not_leader": re-join — once the role cache reflects
+                # the loss, join returns None and the typed redirect
+                # surfaces.
         while True:
             got = node.read_index(group)
             if got is None:

@@ -905,6 +905,7 @@ class RingClient:
                 got = self._shm.try_read(mode, group, query,
                                          max(int(watermark), 0))
             except Exception:                           # noqa: BLE001
+                self._shm.close()      # release the mmap, don't leak
                 self._shm = None       # a broken mapping is dead
             if got is not None:
                 rows, wm = got

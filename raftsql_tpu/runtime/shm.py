@@ -48,6 +48,16 @@ whose mapping no longer matches its attached epoch marks the plane
 dead — remapping a new region mid-flight could alias a rolled-back
 applied index, so restart recovery is deliberately NOT transparent
 (ISSUE 12: stale-epoch remap must fail closed).
+
+Memory-ordering assumption: the seqlock issues no explicit barriers —
+it relies on cross-process mmap stores becoming visible in program
+order, which x86-TSO guarantees (stores are not reordered with other
+stores, so the even-seq header rewrite publishes log_head only after
+the log/table bytes land).  On weakly-ordered architectures (ARM,
+POWER) a reader could observe the even seq before the data stores and
+take an undetected torn snapshot; this plane targets x86-64/Linux
+(the jax_graft host platform) and must grow fences or per-row
+checksums before being trusted elsewhere.
 """
 from __future__ import annotations
 
@@ -313,6 +323,8 @@ class ShmSnapshotReader:
         self._dead = False
         hdr = self._read_header_raw()
         if hdr is None or hdr[0] != _MAGIC or hdr[1] != _VERSION:
+            self.close()         # don't leak the mapping on a failed
+            #                      attach — the caller never sees us
             raise RuntimeError(f"{self.path}: bad snapshot header")
         self.epoch = hdr[4]
         self.num_groups = hdr[3]
@@ -424,7 +436,15 @@ class ShmSnapshotReader:
                 return None
             if time.monotonic_ns() - hdr[8] > PUB_STALE_NS:
                 return None                  # publisher heartbeat stale
-            target = commit
+            # Serve at `applied`, NOT the published commit column: the
+            # apply thread publishes applied before acks fire, so it
+            # covers every acked write, while commit is only restamped
+            # by the ~2ms refresh thread — targeting commit inside that
+            # window could miss a just-acked PUT.  applied never runs
+            # ahead of true commit (entries apply only after commit),
+            # and the applied >= commit guard above keeps the lease
+            # evidence sound.
+            target = applied
         else:
             return None
         with self._lock:

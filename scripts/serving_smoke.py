@@ -198,7 +198,8 @@ def reads_main() -> int:
 
         client = RaftSQLClient([port], timeout_s=10,
                                max_conns_per_node=clients + 4)
-        stats = {"puts": 0, "gets": 0, "stale": 0, "errors": 0}
+        stats = {"puts": 0, "gets": 0, "stale": 0, "errors": 0,
+                 "linear_gets": 0, "linear_stale": 0}
         mu = threading.Lock()
         stop_at = time.monotonic() + seconds
 
@@ -222,11 +223,24 @@ def reads_main() -> int:
                         "SELECT count(*) FROM t", group=g,
                         consistency="session", session=session,
                         deadline_s=10)
+                    # A linear read issued after the PUT acked must
+                    # observe it, whichever path serves it — the shm
+                    # lease fast path gets no refresh-window grace
+                    # (this is exactly the stale-commit-column bug
+                    # class: acked write invisible inside the ~2ms
+                    # restamp window).
+                    lrows, _ = client.get_session(
+                        f"SELECT count(*) FROM t WHERE "
+                        f"k = {ci * 1000000 + k}", group=g,
+                        consistency="linear", deadline_s=10)
                     with mu:
                         stats["puts"] += 1
                         stats["gets"] += 1
+                        stats["linear_gets"] += 1
                         if echo is not None and echo < session:
                             stats["stale"] += 1
+                        if lrows.strip() != "|1|":
+                            stats["linear_stale"] += 1
                 except Exception:                       # noqa: BLE001
                     with mu:
                         stats["errors"] += 1
@@ -243,7 +257,9 @@ def reads_main() -> int:
         reads = m.get("reads", {})
         client.close()
         print(f"serving-smoke --reads: {stats['puts']} PUTs / "
-              f"{stats['gets']} session GETs, {stats['stale']} stale, "
+              f"{stats['gets']} session GETs "
+              f"({stats['linear_gets']} linear), {stats['stale']} "
+              f"stale, {stats['linear_stale']} linear-stale, "
               f"{stats['errors']} errors; shm_hits="
               f"{reads.get('shm_hits')} shm_fallbacks="
               f"{reads.get('shm_fallbacks')}")
@@ -254,6 +270,9 @@ def reads_main() -> int:
         if stats["stale"]:
             return fail(f"{stats['stale']} session reads observed a "
                         "watermark below the client's own PUT")
+        if stats["linear_stale"]:
+            return fail(f"{stats['linear_stale']} linear reads missed "
+                        "an acked PUT (linearizability violation)")
         if not reads.get("shm_hits"):
             return fail("reads.shm_hits == 0: the shared-memory fast "
                         "path served nothing (scrape hit a worker "

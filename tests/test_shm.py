@@ -88,7 +88,7 @@ def test_follower_and_linear_gates(tmp_path):
         assert rdr.try_read("follower", 0, "SELECT count(*) FROM t") is None
         assert rdr.try_read("linear", 0, "SELECT count(*) FROM t") is None
         # Stamp commit + a live lease the way the RingServer refresh
-        # thread does; linear now serves at the commit watermark.
+        # thread does; linear now serves.
         pub.refresh(lambda g: 2, lambda g: 0,
                     lambda g: time.monotonic() + 0.05)
         got = rdr.try_read("linear", 0, "SELECT count(*) FROM t")
@@ -96,6 +96,16 @@ def test_follower_and_linear_gates(tmp_path):
         assert rdr.try_read("follower", 0, "SELECT count(*) FROM t") \
             is not None
         assert rdr.leader_of(0) == 1
+        # Linearizability across the refresh window: a write applied
+        # (and thus acked — publish_deltas runs before acks) but whose
+        # commit column the ~2ms refresh thread hasn't restamped yet
+        # MUST be visible to a linear read.  Serving at the stale
+        # commit column here would drop an acked PUT.
+        pub.refresh(lambda g: 2, lambda g: 0,
+                    lambda g: time.monotonic() + 5.0)
+        pub.publish_deltas({0: [("INSERT INTO t VALUES (2, 'b')", 3)]})
+        got = rdr.try_read("linear", 0, "SELECT count(*) FROM t")
+        assert got is not None and got[0].strip() == "|2|"
         # An expired lease fails closed again.
         pub.refresh(lambda g: 2, lambda g: 0, lambda g: 0.0)
         assert rdr.try_read("linear", 0, "SELECT count(*) FROM t") is None
