@@ -4,7 +4,7 @@
 PY ?= python
 SEED ?= 0
 
-.PHONY: all native test vet bench chaos chaos-membership chaos-procs \
+.PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
 	chaos-mesh chaos-reads chaos-transfer trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
@@ -38,9 +38,13 @@ serving-smoke:
 test:
 	$(PY) -m pytest tests/ -q 2>&1 | tee test.out
 
-# Static analysis stand-in for `go vet`: compile every source file, then
-# the AST checks in scripts/vet.py (unused imports, duplicate defs,
-# mutable defaults, tuple asserts, bare excepts).
+# Static analysis stand-in for `go vet`: compile every source file,
+# then the raftlint suite (raftsql_tpu/analysis/) — the five classic
+# AST rules plus the project-invariant checkers: jit-stability,
+# wall-clock/unseeded-random determinism, thread-ownership,
+# fail-closed, memory-model.  `python -m raftsql_tpu.analysis --list`
+# enumerates the rules; suppress per line with
+# `# raftlint: disable=<rule> -- why`.
 vet:
 	$(PY) -m compileall -q raftsql_tpu tests bench.py __graft_entry__.py \
 	      scripts
@@ -145,6 +149,13 @@ prom-lint:
 trace:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.obs.trace_demo --out trace.json
 
+# AddressSanitizer + UBSan pass over the native WAL stress harness
+# (scripts/native_sanitize.py; add --san tsan for the full trio).
+# Degrades to SKIP where no g++ exists — those hosts run the Python
+# WAL backend.
+native-sanitize:
+	$(PY) scripts/native_sanitize.py
+
 # ThreadSanitizer pass over the native WAL's locking (SURVEY.md §5.2):
 # 4 threads x appends/hardstate/compact/snapshot/sync on one handle.
 tsan:
@@ -155,7 +166,8 @@ tsan:
 	/tmp/wal_stress_tsan /tmp/wal_tsan_dir 2000
 
 clean:
-	rm -f test.out raftsql_tpu/native/_native_*.so
+	rm -f test.out raftsql_tpu/native/_native_*.so \
+	      raftsql_tpu/native/_wal_stress_* raftsql_tpu/native/_http_load
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
 # The durable product paths, quick local shapes (one JSON line each).

@@ -1,0 +1,37 @@
+"""raftlint — the project-invariant static-analysis suite (ISSUE 13).
+
+`scripts/vet.py` started as a 5-rule `go vet` stand-in; this package
+grows it into a checker FRAMEWORK whose passes encode the invariants
+this repo has learned the hard way:
+
+  * jit-stability   — jit entry points must keep ONE call signature
+                      after boot (PR 12: a mid-flight scalar→mask dtype
+                      switch recompiled the step under the leader's
+                      election timer and deposed it);
+  * determinism     — no wall-clock / unseeded randomness in
+                      digest-relevant modules (the chaos plane's
+                      bit-reproducibility is an asserted property);
+  * thread-ownership— cross-thread attribute writes must hold the
+                      attribute's declared lock (PR 7's ring cursors,
+                      PR 11's transfer latches);
+  * fail-closed     — annotated read-serving functions must terminate
+                      every path in an explicit return or raise (PR 12:
+                      every unprovable shm read takes the ring path);
+  * memory-model    — seqlock code must carry its hardware-ordering
+                      assumption as a machine-visible annotation
+                      (runtime/shm.py's x86-TSO dependence);
+  * the five legacy vet rules (unused imports, duplicate defs, mutable
+    defaults, tuple asserts, bare excepts), now per-rule suppressible.
+
+Run it:  `make vet`  or  `python -m raftsql_tpu.analysis [paths...]`.
+Suppress one finding:  `# raftlint: disable=<rule>` on (or one line
+above) the offending line; project-wide intentional exceptions live in
+`analysis/config.py` ALLOWLIST with one-line justifications.
+
+Only the stdlib `ast` module is used — no third-party linters exist in
+this environment, and none are needed for project-shaped invariants.
+"""
+from raftsql_tpu.analysis.core import (Finding, SourceUnit, all_checkers,
+                                       run_suite)
+
+__all__ = ["Finding", "SourceUnit", "all_checkers", "run_suite"]

@@ -1,0 +1,1 @@
+"""raftlint passes.  Importing a module registers its checkers."""

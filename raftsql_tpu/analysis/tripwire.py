@@ -1,0 +1,85 @@
+"""Compile-count tripwire: the runtime falsifier for jit-stability.
+
+The static rule (analysis/checkers/jit_stability.py) argues from call
+sites; this module measures the ground truth.  Each named jit entry
+point exposes its trace-cache entry count (`fn._cache_size()` on a
+jitted callable); the tripwire snapshots the counts when armed and
+reports the delta when read.  A steady-state run that compiles an
+entry point more than once has, by definition, shipped it a second
+trace signature — exactly the mid-flight retrace class that deposed a
+healthy leader in PR 12, whatever the static pass thought of the call
+sites.
+
+Armed by the chaos fast tier (chaos/run.py prints the verdict OUTSIDE
+the digested report — compile counts are host-side facts, not
+consensus results) and by the tier-1 test
+tests/test_raftlint.py::test_tripwire_single_compile_fused.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+
+def _entry_table() -> Dict[str, Callable]:
+    from raftsql_tpu.core import cluster, step
+    return {
+        "cluster_step_jit": cluster.cluster_step_jit,
+        "cluster_step_host": cluster.cluster_step_host,
+        "cluster_multistep_host": cluster.cluster_multistep_host,
+        "cluster_run": cluster.cluster_run,
+        "peer_step_jit": step.peer_step_jit,
+        "peer_step_packed": step.peer_step_packed,
+    }
+
+
+def cache_size(fn) -> Optional[int]:
+    """Trace-cache entry count of a jitted callable, or None when the
+    jax build doesn't expose it (tripwire then reports unknown rather
+    than failing the run on an introspection gap)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:                        # noqa: BLE001
+        return None
+
+
+class JitTripwire:
+    """Snapshot-on-arm / delta-on-read compile counter over the
+    project's jit entry points."""
+
+    def __init__(self, entries: Optional[Dict[str, Callable]] = None):
+        self.entries = dict(entries) if entries is not None \
+            else _entry_table()
+        self._base: Dict[str, Optional[int]] = {
+            name: cache_size(fn) for name, fn in self.entries.items()}
+
+    def baseline(self, name: str) -> Optional[int]:
+        """Cache entries the entry point already had when armed (>0
+        means an earlier run in this process warmed it)."""
+        return self._base.get(name)
+
+    def compiles(self) -> Dict[str, Optional[int]]:
+        """Per-entry compilations since arming (None = unmeasurable)."""
+        out: Dict[str, Optional[int]] = {}
+        for name, fn in self.entries.items():
+            now = cache_size(fn)
+            base = self._base[name]
+            out[name] = None if now is None or base is None \
+                else now - base
+        return out
+
+    def offenders(self, limit: int = 1) -> Dict[str, int]:
+        """Entry points that compiled MORE than `limit` times since
+        arming.  Entries that never ran (0) or can't be measured
+        (None) are not offenders."""
+        return {name: n for name, n in self.compiles().items()
+                if n is not None and n > limit}
+
+    def check(self, limit: int = 1) -> None:
+        """Raise if any armed entry point recompiled past `limit` —
+        one trace signature per entry point is the invariant."""
+        bad = self.offenders(limit)
+        if bad:
+            raise AssertionError(
+                f"jit-stability tripwire: recompiles past limit="
+                f"{limit}: {bad} — a second trace signature reached "
+                f"a steady-state jit entry point")

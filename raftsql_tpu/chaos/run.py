@@ -478,9 +478,14 @@ def main(argv=None) -> int:
     if args.matrix or args.family:
         return run_matrix(args.seed, only=args.family)
 
+    from raftsql_tpu.analysis.tripwire import JitTripwire
     from raftsql_tpu.chaos.schedule import generate
 
     sched = generate(args.seed, ticks=args.ticks)
+    # Armed before the first dispatch; the verdict prints OUTSIDE the
+    # digested reports (compile counts are host-side facts, and the
+    # result digests must stay comparable across tripwire changes).
+    tripwire = JitTripwire()
     reports = []
     for run in range(args.runs):
         r = _run_fused(sched, steps=args.steps)
@@ -493,6 +498,12 @@ def main(argv=None) -> int:
     digests = {(r["schedule_digest"], r["result_digest"])
                for r in reports}
     ok &= _check(len(digests) == 1, f"non-deterministic run: {digests}")
+    compiles = {k: v for k, v in tripwire.compiles().items()
+                if v is not None and v > 0}
+    print(f"jit-tripwire: {json.dumps(compiles, sort_keys=True)}")
+    ok &= _check(not tripwire.offenders(limit=1),
+                 f"jit entry point recompiled mid-run: "
+                 f"{tripwire.offenders(limit=1)}")
     if ok:
         print(f"chaos ok: seed={args.seed} ticks={args.ticks} "
               f"schedule={reports[0]['schedule_digest']} "

@@ -736,6 +736,13 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     o_a_success = succ & ~send_app
     o_a_match = jnp.where(send_app, 0, resp_match)
 
+    # The two type-code planes are built from Python MSG_* literals, so
+    # their jnp.where chains come out weakly-typed — and a jit step
+    # traced on a strong empty inbox then RETRACES when its own output
+    # is fed back on the next tick (the jit-stability tripwire catches
+    # this as a second compile).  Pin them strong to the inbox schema.
+    o_v_type = o_v_type.astype(I32)
+    o_a_type = o_a_type.astype(I32)
     outbox = Outbox(
         v_type=o_v_type, v_term=o_v_term, v_last_idx=o_v_last_idx,
         v_last_term=o_v_last_term, v_granted=o_v_granted,

@@ -101,6 +101,52 @@ def build_http_load():
         return path
 
 
+# Sanitizer build variants for the native WAL stress harness
+# (wal_stress.cc drives 4 threads of appends/hardstate/compact/
+# snapshot/sync on one handle).  `make native-sanitize` builds + runs
+# the asan and ubsan variants; the existing `make tsan` target covers
+# thread.  -O1 keeps stacks honest in reports; -fno-omit-frame-pointer
+# makes asan traces readable; -fno-sanitize-recover turns every ubsan
+# diagnostic into a nonzero exit so CI cannot scroll past one.
+SANITIZERS = {
+    "asan": ("-pthread", "-fsanitize=address",
+             "-fno-omit-frame-pointer"),
+    "ubsan": ("-pthread", "-fsanitize=undefined",
+              "-fno-sanitize-recover=all"),
+    "tsan": ("-pthread", "-fsanitize=thread"),
+}
+
+
+def build_wal_stress(sanitizer: str):
+    """Compile the WAL stress binary under `sanitizer` (a SANITIZERS
+    key); returns the executable path, or None when the toolchain is
+    unavailable (callers degrade to a skip — hosts without g++ are
+    covered by the Python WAL backend)."""
+    flags = SANITIZERS[sanitizer]
+    srcs = [os.path.join(_DIR, "wal_stress.cc"),
+            os.path.join(_DIR, "wal.cc")]
+    exe = os.path.join(_DIR, f"_wal_stress_{sanitizer}")
+    with _lock:
+        key = f"wal_stress_{sanitizer}"
+        if key in _cache:
+            return _cache[key]
+        path = exe
+        try:
+            stale = not os.path.isfile(exe) or any(
+                os.path.getmtime(exe) < os.path.getmtime(s)
+                for s in srcs)
+            if stale and not _compile(
+                    srcs[0], exe,
+                    ("-O1", "-g", *flags, "-fPIC", srcs[1])):
+                path = None
+        except OSError as e:
+            log.warning("wal_stress %s build unavailable (%s)",
+                        sanitizer, e)
+            path = None
+        _cache[key] = path
+        return path
+
+
 def load_native_plog():
     """ctypes handle to the native payload log + combined walplog entry
     points (same shared object as the WAL), or None."""

@@ -238,7 +238,7 @@ class RaftDB:
             pipe.node.snapshot_provider = self._snapshot_of
             pipe.node.snapshot_installer = self._install_snapshot
         self._mu = threading.Lock()
-        self._q2cb: Dict[Tuple[int, str], deque] = defaultdict(deque)
+        self._q2cb: Dict[Tuple[int, str], deque] = defaultdict(deque)  # raftlint: guarded-by=_mu
         self._failed: Optional[Exception] = None
         self._closed = False
         self.latency = LatencyTimer()   # propose→ack, the p50 north star

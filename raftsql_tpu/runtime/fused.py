@@ -65,12 +65,20 @@ class FusedClusterNode(ClusterHostPlane):
     including the multi-step dispatch (RAFTSQL_FUSED_STEPS) and the
     device busy bit that drives idle parking."""
 
+    # Steady-state [P] i32 lockstep advance, built once: the None and
+    # the skew branch must ship the SAME dtype/shape to the jitted step
+    # or a mid-run skew schedule retraces it (and the recompile pause
+    # can depose a healthy leader — the jit-stability invariant).
+    _ti_ones = None
+
     def _device_step(self, prop_n: np.ndarray,
                      timer_inc: Optional[np.ndarray] = None):
         """Dispatch one cluster step; returns (packed-info device array,
         device busy bit).  `timer_inc` is the per-peer [P] timer advance
         (None = lockstep 1s, the steady-state fast path)."""
-        ti = 1 if timer_inc is None \
+        if self._ti_ones is None:
+            self._ti_ones = jnp.ones((self.cfg.num_peers,), jnp.int32)
+        ti = self._ti_ones if timer_inc is None \
             else jnp.asarray(np.asarray(timer_inc, np.int32))
         if self._steps > 1:
             self.states, self.inboxes, pinfos_dev, busy = \

@@ -204,9 +204,10 @@ class ClusterHostPlane:
         # _queued: under the threaded --fused deployment (start()),
         # HTTP client threads propose concurrently with the tick
         # thread's routing and batch pops.
+        # raftlint: guarded-by=_prop_lock
         self._props: List[List[list]] = [
             [[] for _ in range(G)] for _ in range(P)]
-        self._queued: set = set()            # (peer, group) with backlog
+        self._queued: set = set()  # raftlint: guarded-by=_prop_lock
         self._prop_lock = threading.Lock()
         self._hints = np.full(G, -1, np.int64)
         self._tick_no = 0
@@ -260,8 +261,8 @@ class ClusterHostPlane:
         # flight bundles attach for attribution.
         from collections import deque as _deque
         self._xfer_lock = threading.Lock()
-        self._xfer_req: List[Tuple[int, int, int]] = []
-        self._xfers: Dict[int, dict] = {}
+        self._xfer_req: List[Tuple[int, int, int]] = []  # raftlint: guarded-by=_xfer_lock
+        self._xfers: Dict[int, dict] = {}  # raftlint: guarded-by=_xfer_lock
         self._xfer_events = _deque(maxlen=256)
         self._conf_pending: List[list] = []      # per group [(idx, data)]
         self._conf_scrub: List[set] = []         # per group conf indexes

@@ -342,7 +342,7 @@ class RingServer:
         from collections import OrderedDict
         self._tok_mu = threading.Lock()
         # token -> [resolved, err_body|None, waiters [(worker, req_id)]]
-        self._tokens: "OrderedDict[int, list]" = OrderedDict()
+        self._tokens: "OrderedDict[int, list]" = OrderedDict()  # raftlint: guarded-by=_tok_mu
         self._tok_cap = 1 << 16
         for i in range(workers):
             req_p, cpl_p = ring_paths(dirname, i)

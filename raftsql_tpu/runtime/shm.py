@@ -49,17 +49,22 @@ dead — remapping a new region mid-flight could alias a rolled-back
 applied index, so restart recovery is deliberately NOT transparent
 (ISSUE 12: stale-epoch remap must fail closed).
 
-Memory-ordering assumption: the seqlock issues no explicit barriers —
-it relies on cross-process mmap stores becoming visible in program
-order, which x86-TSO guarantees (stores are not reordered with other
-stores, so the even-seq header rewrite publishes log_head only after
-the log/table bytes land).  On weakly-ordered architectures (ARM,
-POWER) a reader could observe the even seq before the data stores and
-take an undetected torn snapshot; this plane targets x86-64/Linux
-(the jax_graft host platform) and must grow fences or per-row
-checksums before being trusted elsewhere.
+The memory-ordering assumption is declared machine-checked below
+(`# raftlint: assumes=x86-tso`): raftlint's memory-model rule refuses
+seqlock-annotated protocol code in any file that does not declare its
+hardware store-order dependence.
 """
 from __future__ import annotations
+
+# raftlint: assumes=x86-tso -- the seqlock issues no explicit barriers:
+# it relies on cross-process mmap stores becoming visible in program
+# order, which x86-TSO guarantees (stores are not reordered with other
+# stores, so the even-seq header rewrite publishes log_head only after
+# the log/table bytes land).  On weakly-ordered architectures (ARM,
+# POWER) a reader could observe the even seq before the data stores and
+# take an undetected torn snapshot; this plane targets x86-64/Linux
+# (the jax_graft host platform) and must grow fences or per-row
+# checksums before being trusted elsewhere.
 
 import mmap
 import os
@@ -158,7 +163,7 @@ class ShmSnapshotPublisher:
                 row[0], row[1], row[2], row[3], row[4], 0)
             off += _ROW_SIZE
 
-    def _publish_locked(self, writes: Callable[[], None]) -> None:
+    def _publish_locked(self, writes: Callable[[], None]) -> None:  # raftlint: seqlock
         """Seqlock write protocol: odd → mutate → even.  The log bytes
         appended by `writes` land BEFORE the header's log_head moves —
         readers never see a head past initialized bytes."""
@@ -340,7 +345,7 @@ class ShmSnapshotReader:
         except (ValueError, struct.error):
             return None
 
-    def _snapshot_table(self):
+    def _snapshot_table(self):  # raftlint: seqlock fail-closed
         """Seqlock read of header + group table: (header, rows) or
         None after bounded retries / on any fail-closed condition.
         The epoch check pins the attachment: a restarted engine's
@@ -368,6 +373,7 @@ class ShmSnapshotReader:
             return h1, rows
         return None
 
+    # raftlint: fail-closed
     def _catch_up(self, rep: _GroupReplica, target: int,
                   log_head: int) -> bool:
         """Feed the replica from the append-only log until its applied
@@ -398,6 +404,7 @@ class ShmSnapshotReader:
 
     # -- read API --------------------------------------------------------
 
+    # raftlint: fail-closed
     def try_read(self, mode: str, group: int, query: str,
                  watermark: int = 0
                  ) -> Optional[Tuple[str, int]]:
@@ -460,7 +467,7 @@ class ShmSnapshotReader:
                 return None                  # surface SQL errors via ring
             return out, int(rep.sm.applied_index())
 
-    def leader_of(self, group: int) -> int:
+    def leader_of(self, group: int) -> int:  # raftlint: fail-closed
         """Published 1-based leader hint (0 unknown), for worker-side
         421 redirects without a ring trip; -0 fail-open to 0."""
         snap = self._snapshot_table()

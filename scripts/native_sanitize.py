@@ -1,0 +1,69 @@
+"""Build + run the native WAL stress harness under sanitizers.
+
+`make native-sanitize` runs the asan and ubsan variants (the existing
+`make tsan` target covers ThreadSanitizer); `--san tsan` adds it here
+for a one-command full pass.  The stress harness (native/wal_stress.cc)
+drives 4 threads of appends/hardstate/compact/snapshot/sync on one WAL
+handle — the exact surface the serving stack hits from its apply and
+HTTP threads — so a clean pass means the C++ fast path holds up where
+raftlint's thread-ownership rule guards the Python side.
+
+Exit 0: every requested sanitizer built, ran, and reported nothing.
+Exit 0 with SKIP: no toolchain (hosts without g++ run the Python WAL
+backend, so there is nothing to sanitize).  Exit 1: a sanitizer fired
+or the stress run failed.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raftsql_tpu.native.build import build_wal_stress  # noqa: E402
+
+
+def run_one(san: str, iters: int) -> bool:
+    exe = build_wal_stress(san)
+    if exe is None:
+        print(f"native-sanitize[{san}]: SKIP (toolchain unavailable)")
+        return True
+    with tempfile.TemporaryDirectory(
+            prefix=f"wal-{san}-") as d:
+        env = dict(os.environ)
+        # halt_on_error makes asan's exit code authoritative; ubsan's
+        # -fno-sanitize-recover already aborts on the first diagnostic.
+        env.setdefault("ASAN_OPTIONS", "halt_on_error=1")
+        proc = subprocess.run([exe, d, str(iters)], env=env,
+                              capture_output=True, text=True,
+                              timeout=600)
+    if proc.returncode != 0:
+        print(f"native-sanitize[{san}]: FAIL rc={proc.returncode}")
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        return False
+    print(f"native-sanitize[{san}]: ok ({iters} iters)")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run the WAL stress harness under sanitizers")
+    ap.add_argument("--san", action="append", default=None,
+                    choices=["asan", "ubsan", "tsan"],
+                    help="sanitizer to run (repeatable; default "
+                         "asan + ubsan)")
+    ap.add_argument("--iters", type=int, default=2000,
+                    help="stress iterations per thread (default 2000)")
+    args = ap.parse_args(argv)
+    sans = args.san or ["asan", "ubsan"]
+    ok = all([run_one(s, args.iters) for s in sans])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,134 +1,23 @@
-"""Static checks — the `go vet` analog (reference Makefile:17-22).
+"""`go vet` stand-in — now a thin shim over raftlint.
 
-No third-party linters ship in this environment, so this is a focused
-AST pass over the tree catching the defect classes that have actually
-bitten or nearly bitten this codebase:
-
-  - unused imports (symbol drift after refactors);
-  - duplicate function/method definitions in one scope (silent shadowing);
-  - mutable default arguments;
-  - `assert (cond, msg)` tuples (always true);
-  - bare `except:` clauses.
-
-Exit 1 with findings, 0 clean.  `python scripts/vet.py [paths...]`.
+The five original AST rules (unused imports, duplicate defs, mutable
+defaults, tuple asserts, bare excepts) moved into the raftlint
+framework (raftsql_tpu/analysis/) alongside the project-invariant
+checkers: jit-stability, determinism (wall-clock + unseeded-random),
+thread-ownership, fail-closed, memory-model.  This entry point stays
+so `make vet` and muscle memory keep working; `python -m
+raftsql_tpu.analysis --list` shows the rules, and per-line suppression
+is `# raftlint: disable=<rule> -- why`.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-def iter_py(paths):
-    for p in paths:
-        if os.path.isfile(p) and p.endswith(".py"):
-            yield p
-        elif os.path.isdir(p):
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs if d != "__pycache__"]
-                for f in files:
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
-
-
-def check_file(path: str) -> list:
-    with open(path, "r", encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
-    out = []
-
-    # ---- unused imports.
-    imported: dict = {}      # name -> (lineno, qualified)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                name = a.asname or a.name.split(".")[0]
-                imported[name] = (node.lineno, a.name)
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                name = a.asname or a.name
-                imported[name] = (node.lineno, a.name)
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-    # Names referenced in docstring-free __all__ or re-exported strings.
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            if node.value in imported:
-                used.add(node.value)
-    if not path.endswith("__init__.py"):     # __init__ imports re-export
-        for name, (lineno, qual) in sorted(imported.items()):
-            if name not in used:
-                out.append((path, lineno, f"unused import: {qual}"))
-
-    # ---- duplicate defs per scope, mutable defaults, assert tuples,
-    # bare excepts.
-    class V(ast.NodeVisitor):
-        def _defs(self, body):
-            seen: dict = {}
-            for st in body:
-                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    if st.name in seen and not any(
-                            isinstance(d, ast.Name) and d.id in
-                            ("property", "overload", "setter")
-                            or isinstance(d, ast.Attribute)
-                            for d in st.decorator_list):
-                        out.append((path, st.lineno,
-                                    f"duplicate def {st.name} "
-                                    f"(first at line {seen[st.name]})"))
-                    seen.setdefault(st.name, st.lineno)
-
-        def visit_Module(self, node):
-            self._defs(node.body)
-            self.generic_visit(node)
-
-        def visit_ClassDef(self, node):
-            self._defs(node.body)
-            self.generic_visit(node)
-
-        def visit_FunctionDef(self, node):
-            for d in node.args.defaults + node.args.kw_defaults:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    out.append((path, node.lineno,
-                                f"mutable default arg in {node.name}"))
-            self.generic_visit(node)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_Assert(self, node):
-            if isinstance(node.test, ast.Tuple) and node.test.elts:
-                out.append((path, node.lineno,
-                            "assert on a tuple is always true"))
-            self.generic_visit(node)
-
-        def visit_ExceptHandler(self, node):
-            if node.type is None:
-                out.append((path, node.lineno, "bare except:"))
-            self.generic_visit(node)
-
-    V().visit(tree)
-    return out
-
-
-def main() -> int:
-    paths = sys.argv[1:] or ["raftsql_tpu", "tests", "bench.py",
-                             "__graft_entry__.py", "scripts"]
-    findings = []
-    for f in iter_py(paths):
-        findings.extend(check_file(f))
-    for path, lineno, msg in findings:
-        print(f"{path}:{lineno}: {msg}")
-    print(f"vet: {len(findings)} finding(s)")
-    return 1 if findings else 0
-
+from raftsql_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

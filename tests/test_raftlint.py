@@ -1,0 +1,332 @@
+"""raftlint (raftsql_tpu/analysis/) — checker fixtures + live tree.
+
+Per checker: a must-flag snippet (the defect class, distilled) and a
+must-pass twin (the sanctioned idiom), run through `unit_from_source`
+against a stub config so the fixtures are hermetic.  Then the teeth:
+the COMMITTED tree must be raftlint-clean (the tier-1 gate behind
+`make vet`), and the jit compile-count tripwire must observe exactly
+one compilation of the fused cluster step across a mini chaos run —
+the runtime falsifier for the jit-stability rule.
+"""
+import dataclasses
+import tempfile
+
+import pytest
+
+from raftsql_tpu.analysis import config as live_config
+from raftsql_tpu.analysis.core import (all_checkers, run_suite,
+                                       run_units, unit_from_source)
+
+
+class StubConfig:
+    """Bare config: every scope empty unless a test opts in."""
+    DEFAULT_PATHS = []
+    DETERMINISM_PATHS = ["src/"]
+    JIT_ENTRY_POINTS = {"step_jit"}
+    JIT_STATIC_ARGS = {"step_jit": {0, "cfg"}}
+    JIT_SKIP_MIXING_PREFIXES = ()
+    OWNERSHIP_REQUIRED = {}
+    FAILCLOSED_REQUIRED = {}
+    ALLOWLIST = []
+    allowlist = ALLOWLIST
+
+
+def lint(src, relpath="src/mod.py", rules=None, config=None):
+    unit = unit_from_source(src, relpath)
+    return run_units([unit], config or StubConfig(), rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- framework ----------------------------------------------------------
+
+def test_registered_rule_set():
+    names = {c.name for c in all_checkers()}
+    assert {"unused-import", "duplicate-def", "mutable-default",
+            "assert-tuple", "bare-except", "wall-clock",
+            "unseeded-random", "jit-stability", "thread-ownership",
+            "fail-closed", "memory-model"} <= names
+
+
+def test_suppression_comment_silences_one_line():
+    src = "import time\ntime.time()  # raftlint: disable=wall-clock -- test\n"
+    assert lint(src, rules=["wall-clock"]) == []
+    src = "import time\ntime.time()\n"
+    assert rules_of(lint(src, rules=["wall-clock"])) == ["wall-clock"]
+
+
+def test_skip_file_opts_out_entirely():
+    src = "# raftlint: skip-file\nimport time\ntime.time()\n"
+    assert lint(src) == []
+
+
+def test_allowlist_requires_matching_entry():
+    cfg = StubConfig()
+    cfg.allowlist = [{"rule": "wall-clock", "path": "src/mod.py",
+                      "why": "test"}]
+    assert lint("import time\ntime.time()\n", rules=["wall-clock"],
+                config=cfg) == []
+
+
+# -- the five classic rules --------------------------------------------
+
+@pytest.mark.parametrize("rule,bad,good", [
+    ("unused-import", "import os\n", "import os\nos.getcwd()\n"),
+    ("duplicate-def", "def f():\n    pass\ndef f():\n    pass\n",
+     "def f():\n    pass\ndef g():\n    pass\n"),
+    ("mutable-default", "def f(x=[]):\n    pass\n",
+     "def f(x=None):\n    pass\n"),
+    ("assert-tuple", "assert (1 == 1, 'msg')\n", "assert 1 == 1, 'msg'\n"),
+    ("bare-except", "try:\n    pass\nexcept:\n    pass\n",
+     "try:\n    pass\nexcept ValueError:\n    pass\n"),
+])
+def test_classic_rules(rule, bad, good):
+    assert rules_of(lint(bad, rules=[rule])) == [rule]
+    assert lint(good, rules=[rule]) == []
+
+
+# -- determinism --------------------------------------------------------
+
+def test_wall_clock_flags_time_time_in_scope_only():
+    src = "import time\nt = time.time()\n"
+    assert rules_of(lint(src, rules=["wall-clock"])) == ["wall-clock"]
+    # Out of DETERMINISM_PATHS scope: clean.
+    assert lint(src, relpath="tools/x.py", rules=["wall-clock"]) == []
+    # The sanctioned clock is untouched.
+    assert lint("import time\nt = time.monotonic()\n",
+                rules=["wall-clock"]) == []
+
+
+def test_unseeded_random_flags_global_rng_not_keyed_jax():
+    bad = "import random\nx = random.random()\n"
+    assert rules_of(lint(bad, rules=["unseeded-random"])) \
+        == ["unseeded-random"]
+    assert rules_of(lint("import random\nr = random.Random()\n",
+                         rules=["unseeded-random"])) == ["unseeded-random"]
+    # Seeded constructions and keyed jax.random are the sanctioned forms.
+    assert lint("import random\nr = random.Random(42)\n",
+                rules=["unseeded-random"]) == []
+    assert lint("import jax\nx = jax.random.randint(key, (), 0, 9)\n",
+                rules=["unseeded-random"]) == []
+    assert lint("import numpy as np\nr = np.random.default_rng(7)\n",
+                rules=["unseeded-random"]) == []
+    assert rules_of(lint("import numpy as np\nr = np.random.default_rng()\n",
+                         rules=["unseeded-random"])) == ["unseeded-random"]
+
+
+# -- jit-stability ------------------------------------------------------
+
+DTYPE_SWITCH = """\
+def tick(self, timer_inc=None):
+    ti = 1 if timer_inc is None else jnp.asarray(timer_inc)
+    return step_jit(cfg, state, ti)
+"""
+
+BOOT_FIXED = """\
+def tick(self, timer_inc=None):
+    ti = self._ti_ones if timer_inc is None else jnp.asarray(timer_inc)
+    return step_jit(cfg, state, ti)
+"""
+
+
+def test_jit_stability_flags_conditional_literal_arg():
+    # The PR 12 defect class, distilled: scalar on one branch, array on
+    # the other, feeding a jit entry point -> two trace signatures.
+    assert rules_of(lint(DTYPE_SWITCH, rules=["jit-stability"])) \
+        == ["jit-stability"]
+    assert lint(BOOT_FIXED, rules=["jit-stability"]) == []
+
+
+def test_jit_stability_flags_cross_site_literal_mixing():
+    src = ("def a():\n    return step_jit(cfg, state, 1)\n"
+           "def b(arr):\n    return step_jit(cfg, state, arr)\n")
+    assert rules_of(lint(src, rules=["jit-stability"])) \
+        == ["jit-stability"]
+    # Same literal everywhere: one signature, clean.
+    same = ("def a():\n    return step_jit(cfg, state, 1)\n"
+            "def b():\n    return step_jit(cfg, state, 1)\n")
+    assert lint(same, rules=["jit-stability"]) == []
+
+
+def test_jit_stability_static_args_exempt():
+    # cfg (static_argnums=0) varies as a Python value by design.
+    src = ("def a(c1, c2, x):\n"
+           "    step_jit(c1, state, x)\n"
+           "    step_jit(2, state, x)\n")
+    assert lint(src, rules=["jit-stability"]) == []
+
+
+def test_jit_stability_flags_jit_in_loop():
+    src = ("import jax\n"
+           "def f(xs):\n"
+           "    for x in xs:\n"
+           "        g = jax.jit(lambda y: y)\n"
+           "        g(x)\n")
+    assert rules_of(lint(src, rules=["jit-stability"])) \
+        == ["jit-stability"]
+
+
+# -- thread-ownership ---------------------------------------------------
+
+LOCKFREE_WRITE = """\
+import threading
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._props = []  # raftlint: guarded-by=_lock
+
+    def propose(self, item):
+        self._props.append(item)
+"""
+
+LOCKED_WRITE = """\
+import threading
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._props = []  # raftlint: guarded-by=_lock
+
+    def propose(self, item):
+        with self._lock:
+            self._props.append(item)
+
+    def peek(self):
+        return len(self._props)   # lock-free READ: sanctioned idiom
+"""
+
+OWNER_OPT_OUT = """\
+import threading
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._props = []  # raftlint: guarded-by=_lock
+
+    def drain(self):  # raftlint: owner=tick-thread -- close() joins first
+        self._props = []
+"""
+
+
+def test_ownership_flags_lock_free_write():
+    got = lint(LOCKFREE_WRITE, rules=["thread-ownership"])
+    assert rules_of(got) == ["thread-ownership"]
+    assert "_props" in got[0].message and "_lock" in got[0].message
+
+
+def test_ownership_passes_locked_write_and_lock_free_read():
+    assert lint(LOCKED_WRITE, rules=["thread-ownership"]) == []
+
+
+def test_ownership_owner_annotation_opts_method_out():
+    assert lint(OWNER_OPT_OUT, rules=["thread-ownership"]) == []
+
+
+def test_ownership_registry_pins_required_annotations():
+    cfg = StubConfig()
+    cfg.OWNERSHIP_REQUIRED = {("mod.py", "Plane"): {"_props": "_lock"}}
+    bare = ("class Plane:\n"
+            "    def __init__(self):\n"
+            "        self._props = []\n")
+    got = lint(bare, relpath="src/mod.py", rules=["thread-ownership"],
+               config=cfg)
+    assert rules_of(got) == ["thread-ownership"]
+    assert "guarded-by=_lock" in got[0].message
+
+
+# -- fail-closed + memory-model ----------------------------------------
+
+FALLS_OFF_END = """\
+def try_read(mode):  # raftlint: fail-closed
+    if mode == "local":
+        return 1
+    elif mode == "linear":
+        return 2
+"""
+
+EXPLICIT_FALLBACK = """\
+def try_read(mode):  # raftlint: fail-closed
+    if mode == "local":
+        return 1
+    elif mode == "linear":
+        return 2
+    return None
+"""
+
+SWALLOWING_HANDLER = """\
+def try_read(q):  # raftlint: fail-closed
+    try:
+        out = run(q)
+    except Exception:
+        out = None
+    return out
+"""
+
+
+def test_fail_closed_flags_fall_off_the_end():
+    got = lint(FALLS_OFF_END, rules=["fail-closed"])
+    assert rules_of(got) == ["fail-closed"]
+    assert lint(EXPLICIT_FALLBACK, rules=["fail-closed"]) == []
+
+
+def test_fail_closed_flags_swallowing_handler():
+    assert rules_of(lint(SWALLOWING_HANDLER, rules=["fail-closed"])) \
+        == ["fail-closed"]
+
+
+def test_fail_closed_only_applies_to_annotated_defs():
+    plain = "def f(mode):\n    if mode:\n        return 1\n"
+    assert lint(plain, rules=["fail-closed"]) == []
+
+
+def test_memory_model_requires_file_level_assumes():
+    bare = "def read():  # raftlint: seqlock\n    return 1\n"
+    assert rules_of(lint(bare, rules=["memory-model"])) \
+        == ["memory-model"]
+    declared = ("# raftlint: assumes=x86-tso\n"
+                "def read():  # raftlint: seqlock\n    return 1\n")
+    assert lint(declared, rules=["memory-model"]) == []
+
+
+# -- the teeth ----------------------------------------------------------
+
+def test_live_tree_is_raftlint_clean():
+    """The committed tree passes the full suite — same gate as
+    `make vet` / the CI lint job."""
+    findings = run_suite(live_config.DEFAULT_PATHS)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_allowlist_entries_carry_justifications():
+    for entry in live_config.ALLOWLIST:
+        assert entry.get("why"), f"allowlist entry without why: {entry}"
+        assert entry.get("rule") and entry.get("path")
+
+
+def test_tripwire_single_compile_fused():
+    """Runtime falsifier for jit-stability: a fused chaos run compiles
+    each jit entry point it exercises exactly once — the None and the
+    skew timer_inc branches, the restart path, and every nemesis
+    transform all feed ONE trace signature."""
+    from raftsql_tpu.analysis.tripwire import JitTripwire
+    from raftsql_tpu.chaos.schedule import generate_skew
+    from raftsql_tpu.chaos.scenarios import FusedChaosRunner
+
+    # The skew family flips timer_inc between None and a [P] vector
+    # mid-run — the exact historical recompile schedule.
+    sched = generate_skew(3)
+    sched = dataclasses.replace(sched, ticks=min(sched.ticks, 120))
+    tw = JitTripwire()
+    with tempfile.TemporaryDirectory(prefix="raftlint-tw-") as d:
+        FusedChaosRunner(sched, d).run()
+    compiles = tw.compiles()
+    # A fresh process must compile exactly once; when an earlier test
+    # in the suite already warmed the cache, a hit (delta 0) is the
+    # same single-signature property — never a second compile.
+    warm = tw.baseline("cluster_step_host") or 0
+    assert compiles.get("cluster_step_host") in \
+        ({0, 1} if warm else {1}), compiles
+    assert tw.offenders(limit=1) == {}, compiles
