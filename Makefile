@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh chaos-reads chaos-transfer trace prom-lint clean
+	chaos-mesh chaos-reads chaos-transfer chaos-reshard trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -119,6 +119,24 @@ chaos-reads:
 chaos-transfer:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --transfers --seed $(SEED)
+
+# Elastic-keyspace nemesis (raftsql_tpu/reshard/): seeded group
+# SPLIT / MERGE / MIGRATE schedules racing partitions, message drops,
+# whole-cluster crash+restart, coordinator SIGKILL mid-verb (rebuilt
+# from the raft-log journal fold alone) and a disk fault on the
+# migrate snapshot ship — under live acked-PUT load, checked by
+# NoAckedWriteLost (every acked write readable in exactly one
+# post-reshard group, WAL-fold post-mortem after every restart) and
+# NoAvailabilityLoss (writes outside the moving range never stall past
+# a bound; verbs always resolve).  The family runs twice and is
+# digest-compared, then the PREMATURE-FLIP falsification pair: a
+# coordinator that flips the router before the destination durably
+# applied the copies MUST be caught on a directed copy-starving
+# schedule; the correct coordinator must complete the same schedule.
+#   make chaos-reshard SEED=17
+chaos-reshard:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --reshard --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

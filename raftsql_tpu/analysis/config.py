@@ -89,6 +89,13 @@ OWNERSHIP_REQUIRED = {
     ("runtime/ring.py", "RingServer"): {
         "_tokens": "_tok_mu",        # retry-token LRU: drain threads
     },
+    ("reshard/coordinator.py", "ReshardCoordinator"): {
+        "_cur": "_mu",               # enqueue/doc threads vs the
+        "_steps": "_mu",             # step() driver thread
+        "_next_id": "_mu",
+        "events": "_mu",
+        "counters": "_mu",
+    },
 }
 
 # ---------------------------------------------------------------------
@@ -108,6 +115,12 @@ FAILCLOSED_REQUIRED = {
         "fail-closed": ["_snapshot_table", "_catch_up", "try_read",
                         "leader_of"],
         "seqlock": ["_snapshot_table", "_publish_locked"],
+    },
+    # The router flip is the one place a reshard can lose acked writes
+    # (flip before the copy fence) or serve a moved key from the wrong
+    # group: every path must end in an explicit publish/return.
+    "reshard/coordinator.py": {
+        "fail-closed": ["_flip_router"],
     },
 }
 

@@ -139,7 +139,14 @@ class _Conn(asyncio.Protocol):
             if req is None:
                 return
             method, path, headers, body = req
-            if method == b"PUT":
+            if path.startswith(b"/kv/") and method in (b"PUT", b"GET"):
+                # Keyed surface over the elastic keyspace
+                # (raftsql_tpu/reshard/): routed by hash slot through
+                # the reshard plane's keymap, epoch fail-closed.
+                self.busy = True
+                self.srv.loop.create_task(
+                    self._do_kv(method, path, headers, body))
+            elif method == b"PUT":
                 self.busy = True
                 self.srv.loop.create_task(self._do_put(headers, body))
             elif method == b"GET":
@@ -191,6 +198,9 @@ class _Conn(asyncio.Protocol):
             elif method == b"POST" and path == b"/transfer":
                 self.busy = True
                 self.srv.loop.create_task(self._do_transfer(body))
+            elif method == b"POST" and path == b"/reshard":
+                self.busy = True
+                self.srv.loop.create_task(self._do_reshard(body))
             elif method == b"HEAD":
                 self.tr.write(_ALLOW_NOBODY)
             else:
@@ -215,6 +225,7 @@ class _Conn(asyncio.Protocol):
             session = 0
             token = None
             accept = b""
+            kepoch = None
             for line in head[1:]:
                 k, _, v = line.partition(b":")
                 k = k.strip().lower()
@@ -237,6 +248,11 @@ class _Conn(asyncio.Protocol):
                     # Hex u64 retry token: pins the proposal's envelope
                     # id so client re-sends apply exactly once.
                     token = int(v.strip(), 16) & ((1 << 64) - 1)
+                elif k == b"x-raft-keymap-epoch":
+                    # Elastic keyspace: the mapping version the client
+                    # routed by — the reshard plane fails closed on
+                    # any mismatch (409 + the current keymap).
+                    kepoch = int(v.strip())
         except (ValueError, IndexError):
             self._fail(b"malformed request\n")
             return None
@@ -250,7 +266,8 @@ class _Conn(asyncio.Protocol):
         del buf[:total]
         return method, path, {"group": group, "mode": mode,
                               "session": session, "token": token,
-                              "accept": accept.decode("latin-1")}, body
+                              "accept": accept.decode("latin-1"),
+                              "kepoch": kepoch}, body
 
     def _fail(self, msg: bytes) -> None:
         self.tr.write(_resp(400, b"Bad Request", msg))
@@ -376,6 +393,127 @@ class _Conn(asyncio.Protocol):
         self._finish(_resp(200, b"OK",
                            (_json.dumps(got, sort_keys=True)
                             + "\n").encode(), b"application/json"))
+
+    async def _do_reshard(self, body: bytes) -> None:
+        """POST /reshard — enqueue an elastic-keyspace verb, parity
+        with api/http.py: 200 + verb JSON, 409 while a verb is in
+        flight, 503 with no plane compiled in."""
+        import json as _json
+        rdb = self.srv.rdb
+        if rdb.reshard is None:
+            self._finish(_resp(503, b"Service Unavailable",
+                               b"no reshard plane (--reshard)\n"))
+            return
+        from raftsql_tpu.reshard.coordinator import ReshardRefused
+        try:
+            req = _json.loads(body.decode("utf-8") or "{}")
+            got = rdb.reshard.enqueue(str(req.get("verb", "")),
+                                      int(req.get("src", -1)),
+                                      int(req.get("dst", -1)),
+                                      req.get("slots"))
+        except ReshardRefused as e:
+            self._finish(_resp(409, b"Conflict",
+                               (str(e) + "\n").encode()))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        self._finish(_resp(200, b"OK",
+                           (_json.dumps(got, sort_keys=True)
+                            + "\n").encode(), b"application/json"))
+
+    async def _do_kv(self, method: bytes, path: bytes,
+                     headers: dict, body: bytes) -> None:
+        """PUT/GET /kv/<key> — the keyed elastic-keyspace surface.
+        Responses pin X-Raft-Keymap-Epoch; a request routed by a stale
+        epoch is refused with 409 + the current keymap document (fail
+        closed — never silently served by a moved mapping)."""
+        import json as _json
+        rdb = self.srv.rdb
+        plane = rdb.reshard
+        if plane is None:
+            self._finish(_resp(503, b"Service Unavailable",
+                               b"no reshard plane (--reshard)\n"))
+            return
+        from raftsql_tpu.reshard.plane import FrozenSlot, WrongEpoch
+        key = path[len(b"/kv/"):].decode("utf-8")
+
+        def _epoch_extra():
+            return ((b"X-Raft-Keymap-Epoch",
+                     str(plane.keymap.epoch).encode()),)
+
+        fut = None
+        sql, group = "", 0
+        try:
+            if method == b"PUT":
+                group, sql = plane.kv_put(key, body.decode("utf-8"),
+                                          headers["kepoch"])
+                fut = rdb.propose(sql, group, token=headers["token"])
+                afut = self.srv.loop.create_future()
+                fut.add_done_callback(
+                    lambda err: self.srv.bridge.deliver(afut, err))
+                err = await asyncio.wait_for(afut, self.srv.timeout_s)
+                if err is not None:
+                    raise err
+                extra = (_session_extra(rdb, group) + _epoch_extra())
+                head = b"HTTP/1.1 204 No Content\r\n" + b"".join(
+                    k + b": " + v + b"\r\n" for k, v in extra) + b"\r\n"
+                self._finish(head)
+                return
+            group, sql = plane.kv_get(key, headers["kepoch"])
+            rows = await self.srv.loop.run_in_executor(
+                self.srv._read_pool, lambda: rdb.query(
+                    sql, group, timeout=self.srv.timeout_s,
+                    mode=headers["mode"],
+                    watermark=headers["session"]))
+        except WrongEpoch as e:
+            payload = (_json.dumps(
+                {"error": str(e), "keymap": plane.keymap.to_doc()},
+                sort_keys=True) + "\n").encode()
+            self._finish(_resp(409, b"Conflict", payload,
+                               b"application/json",
+                               extra=_epoch_extra()))
+            return
+        except FrozenSlot as e:
+            # Retryable: the verb resolves and unfreezes the slot.
+            self._finish(_resp(503, b"Service Unavailable",
+                               (str(e) + "\n").encode(),
+                               extra=((b"Retry-After", b"1"),)))
+            return
+        except asyncio.TimeoutError:
+            rdb.abandon(sql, group, fut)
+            self._finish(_resp(
+                400, b"Bad Request", b"proposal not committed in time\n"))
+            return
+        except NotLeaderError as e:
+            extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
+                if e.leader > 0 else ()
+            self._finish(_resp(421, b"Misdirected Request",
+                               (str(e) + "\n").encode(), extra=extra))
+            return
+        except TimeoutError as e:
+            self._finish(_resp(503, b"Service Unavailable",
+                               (str(e) + "\n").encode()))
+            return
+        except Exception as e:                      # noqa: BLE001
+            log.info("client error: %s", e)
+            if fut is not None:
+                try:
+                    rdb.abandon(sql, group, fut)
+                except Exception:                   # noqa: BLE001
+                    pass
+            self._finish(_resp(400, b"Bad Request",
+                               (str(e) + "\n").encode()))
+            return
+        extra = _session_extra(rdb, group) + _epoch_extra()
+        val = plane.kv_value(rows)
+        if val is None:
+            self._finish(_resp(404, b"Not Found", b"", extra=extra))
+        else:
+            self._finish(_resp(200, b"OK", val.encode("utf-8"),
+                               extra=extra))
 
     async def _do_get(self, headers: dict, body: bytes) -> None:
         rdb = self.srv.rdb

@@ -161,6 +161,7 @@ class RaftSQLClient:
         self._lease: Dict[int, Tuple[int, float]] = {}
         #   group -> (node index, monotonic lease-hint expiry)
         self._hints_at = 0.0                   # last /healthz sweep
+        self._keymap: Optional[dict] = None    # elastic-keyspace doc
         self._rr = 0                           # round-robin cursor
         self._pools = [_NodePool(h, p, max_conns_per_node,
                                  max_idle_per_node)
@@ -260,6 +261,9 @@ class RaftSQLClient:
                 lease = row.get("lease_s")
                 if isinstance(lease, (int, float)) and lease > 0:
                     leases[g] = (idx, now + float(lease))
+            # Elastic keyspace (raftsql_tpu/reshard/): adopt the
+            # newest published key->group mapping seen on the sweep.
+            self._note_keymap(doc.get("keymap"))
         with self._mu:
             self._leader.update(leaders)
             self._lease.update(leases)
@@ -438,6 +442,187 @@ class RaftSQLClient:
                 raise Unavailable(
                     f"GET {sql!r} (group {group}): no answer before "
                     f"deadline; last={last!r}")
+
+    # -- elastic keyspace (/kv surface, raftsql_tpu/reshard/) ----------
+
+    def _note_keymap(self, doc) -> bool:
+        """Adopt a key->group mapping document if it is NEWER than the
+        cached one (mapping epochs only move forward — a stale sweep
+        must not roll the router back).  Returns True on adoption."""
+        if not isinstance(doc, dict) or "epoch" not in doc:
+            return False
+        with self._mu:
+            have = self._keymap
+            if have is not None \
+                    and int(have.get("epoch", -1)) >= int(doc["epoch"]):
+                return False
+            self._keymap = doc
+            return True
+
+    def keymap_epoch(self) -> Optional[int]:
+        """The cached mapping version, or None before any /kv traffic
+        or /healthz sweep saw a reshard-enabled node."""
+        with self._mu:
+            return (int(self._keymap["epoch"])
+                    if self._keymap is not None else None)
+
+    def refresh_keymap(self, timeout_s: float = 1.0) -> Optional[int]:
+        """Sweep /healthz for the current key->group mapping (the
+        unknown-group recovery path after a split/merge moved the
+        keyspace under this client).  Returns the adopted epoch."""
+        for idx in range(len(self.nodes)):
+            doc = self.health(idx, timeout_s=timeout_s)
+            if doc:
+                self._note_keymap(doc.get("keymap"))
+        return self.keymap_epoch()
+
+    def _kv_headers(self) -> dict:
+        headers = {}
+        epoch = self.keymap_epoch()
+        if epoch is not None:
+            headers["X-Raft-Keymap-Epoch"] = str(epoch)
+        return headers
+
+    def _note_kv_epoch(self, hdrs: dict) -> None:
+        """Every /kv response echoes the epoch it served under; a
+        NEWER one than our cache means the keyspace moved (split/merge
+        behind our back) — sweep /healthz for the full mapping so
+        subsequent requests pin the current epoch."""
+        e = hdrs.get("X-Raft-Keymap-Epoch")
+        if e is None or not e.isdigit():
+            return
+        have = self.keymap_epoch()
+        if have is None or int(e) > have:
+            self.refresh_keymap()
+
+    def _kv_refused(self, status: int, text: str) -> bool:
+        """Handle a 409 mapping-epoch refusal: adopt the server's
+        CURRENT keymap from the response body (fallback: a /healthz
+        sweep) and tell the caller to retry immediately."""
+        import json
+        if status != 409:
+            return False
+        try:
+            self._note_keymap(json.loads(text).get("keymap"))
+        except ValueError:
+            self.refresh_keymap()
+        return True
+
+    def put_kv(self, key: str, value: str,
+               deadline_s: float = 60.0,
+               token: Optional[int] = None) -> Optional[int]:
+        """Keyed write over the elastic keyspace (PUT /kv/<key>): the
+        server routes by hash slot under its CURRENT mapping; this
+        client pins the epoch it believes in and fails closed — a 409
+        (the mapping moved: split/merge/migrate) refreshes the cache
+        and retries, a frozen-slot 503 backs off until the verb
+        resolves.  Exactly-once via the same retry-token contract as
+        put()."""
+        from urllib.parse import quote
+        token = secrets.randbits(64) if token is None else token
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        last: object = None
+        path = "/kv/" + quote(key, safe="")
+        while True:
+            headers = self._kv_headers()
+            headers["X-Raft-Retry-Token"] = f"{token:016x}"
+            for idx in self._order(0, None):
+                try:
+                    status, hdrs, text = self.raw(
+                        idx, "PUT", path, value, headers)
+                except _RETRYABLE_OS as e:
+                    last = e
+                    continue
+                if status == 204:
+                    self._note_kv_epoch(hdrs)
+                    return self._session_of(hdrs)
+                if self._kv_refused(status, text):
+                    last = (status, "keymap moved")
+                    break              # re-route under the new mapping
+                if status == 400:
+                    raise SQLError(status, text)
+                last = (status, text.strip())
+            attempt += 1
+            if time.monotonic() >= deadline \
+                    or not self._sleep_backoff(attempt, deadline):
+                raise Unavailable(
+                    f"PUT /kv/{key}: no ack before deadline; "
+                    f"last={last!r}")
+
+    def get_kv(self, key: str, deadline_s: float = 60.0,
+               consistency: Optional[str] = None,
+               session: int = 0) -> Optional[str]:
+        """Keyed read (GET /kv/<key>): the value, or None when the key
+        does not exist.  Same mapping-epoch fail-closed handling as
+        put_kv."""
+        from urllib.parse import quote
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        last: object = None
+        path = "/kv/" + quote(key, safe="")
+        while True:
+            headers = self._kv_headers()
+            if consistency and consistency != "local":
+                headers["X-Consistency"] = consistency
+            if session > 0:
+                headers["X-Raft-Session"] = str(session)
+            for idx in self._order(0, None):
+                try:
+                    status, hdrs, text = self.raw(
+                        idx, "GET", path, "", headers)
+                except _RETRYABLE_OS as e:
+                    last = e
+                    continue
+                if status == 200:
+                    self._note_kv_epoch(hdrs)
+                    return text
+                if status == 404:
+                    self._note_kv_epoch(hdrs)
+                    return None
+                if self._kv_refused(status, text):
+                    last = (status, "keymap moved")
+                    break
+                if status == 400:
+                    raise SQLError(status, text)
+                last = (status, text.strip())
+            attempt += 1
+            if time.monotonic() >= deadline \
+                    or not self._sleep_backoff(attempt, deadline):
+                raise Unavailable(
+                    f"GET /kv/{key}: no answer before deadline; "
+                    f"last={last!r}")
+
+    def reshard(self, verb: str, src: int, dst: int, slots=None,
+                node: Optional[int] = None,
+                deadline_s: float = 10.0) -> dict:
+        """POST /reshard: enqueue an elastic-keyspace verb.  409 (a
+        verb already in flight) surfaces as SQLError — the caller
+        decides whether to wait."""
+        import json
+        body = json.dumps({"verb": verb, "src": src, "dst": dst,
+                           "slots": slots})
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        last: object = None
+        while True:
+            for idx in self._order(0, node):
+                try:
+                    status, _hdrs, text = self.raw(
+                        idx, "POST", "/reshard", body)
+                except _RETRYABLE_OS as e:
+                    last = e
+                    continue
+                if status == 200:
+                    return json.loads(text)
+                if status in (400, 409):
+                    raise SQLError(status, text)
+                last = (status, text.strip())
+            attempt += 1
+            if time.monotonic() >= deadline \
+                    or not self._sleep_backoff(attempt, deadline):
+                raise Unavailable(
+                    f"POST /reshard {verb}: no answer; last={last!r}")
 
     def get_until(self, sql: str, want: str, group: int = 0,
                   node: Optional[int] = None,

@@ -84,7 +84,94 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 return None
             return int(tok, 16) & ((1 << 64) - 1)
 
+        def _epoch_hdr(self) -> Optional[int]:
+            """X-Raft-Keymap-Epoch: the mapping version the client
+            routed by.  The reshard plane fails closed on any
+            mismatch (api/client.py refreshes from /healthz)."""
+            e = self.headers.get("X-Raft-Keymap-Epoch")
+            return int(e) if e is not None else None
+
+        def _kv_refused(self, e: Exception) -> bool:
+            """Map reshard routing refusals onto responses.  Returns
+            True when the request was answered here."""
+            from raftsql_tpu.reshard.plane import FrozenSlot, WrongEpoch
+            if isinstance(e, WrongEpoch):
+                # 409 + the CURRENT keymap document: the client swaps
+                # its cached mapping and re-routes — never served with
+                # a mapping the router may have moved under it.
+                body = json.dumps(
+                    {"error": str(e),
+                     "keymap": rdb.reshard.keymap.to_doc()},
+                    sort_keys=True) + "\n"
+                self._send(409, body.encode(),
+                           ctype="application/json",
+                           headers={"X-Raft-Keymap-Epoch":
+                                    str(e.have)})
+                return True
+            if isinstance(e, FrozenSlot):
+                # Retryable: the verb resolves and unfreezes the slot.
+                self._send(503, (str(e) + "\n").encode("utf-8"),
+                           headers={"Retry-After": "1"})
+                return True
+            return False
+
+        def _do_kv(self, key: str):
+            """Keyed surface over the elastic keyspace: the reshard
+            plane routes by hash slot, the response pins the mapping
+            epoch it served under."""
+            if rdb.reshard is None:
+                self._body()    # drain — keep-alive
+                self._send(503, b"no reshard plane (--reshard)\n")
+                return
+            plane = rdb.reshard
+            try:
+                if self.command == "PUT":
+                    group, sql = plane.kv_put(key, self._body(),
+                                              self._epoch_hdr())
+                    fut = rdb.propose(sql, group,
+                                      token=self._retry_token())
+                    try:
+                        err = fut.wait(timeout_s)
+                    except TimeoutError:
+                        rdb.abandon(sql, group, fut)
+                        raise
+                    if err is not None:
+                        raise err
+                    hdrs = _session_headers(rdb, group) or {}
+                    hdrs["X-Raft-Keymap-Epoch"] = str(plane.keymap.epoch)
+                    self._send(204, headers=hdrs)
+                    return
+                group, sql = plane.kv_get(key, self._epoch_hdr())
+                mode = (self.headers.get("X-Consistency", "")
+                        .lower() or "local")
+                wm = int(self.headers.get("X-Raft-Session") or 0)
+                self._body()    # drain — keep-alive
+                rows = rdb.query(sql, group, timeout=timeout_s,
+                                 mode=mode, watermark=wm)
+            except NotLeaderError as e:
+                self._send(421, (str(e) + "\n").encode("utf-8"),
+                           headers={"X-Raft-Leader": str(e.leader)}
+                           if e.leader > 0 else None)
+                return
+            except TimeoutError as e:
+                self._send(503, (str(e) + "\n").encode("utf-8"))
+                return
+            except Exception as e:
+                if not self._kv_refused(e):
+                    self._err(e)
+                return
+            hdrs = _session_headers(rdb, group) or {}
+            hdrs["X-Raft-Keymap-Epoch"] = str(plane.keymap.epoch)
+            val = plane.kv_value(rows)
+            if val is None:
+                self._send(404, b"", headers=hdrs)
+            else:
+                self._send(200, val.encode("utf-8"), headers=hdrs)
+
         def do_PUT(self):
+            if self.path.startswith("/kv/"):
+                self._do_kv(self.path[len("/kv/"):])
+                return
             try:
                 query, group = self._body(), self._group()
                 fut = rdb.propose(query, group, token=self._retry_token())
@@ -107,6 +194,9 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(204, headers=_session_headers(rdb, group))
 
         def do_GET(self):
+            if self.path.startswith("/kv/"):
+                self._do_kv(self.path[len("/kv/"):])
+                return
             if self.path == "/healthz":
                 # Readiness: id, per-group role/leader/term/applied.
                 # Answering at all proves boot + replay completed (the
@@ -203,11 +293,35 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             # {"group": 0, "target": <slot>} (graceful leadership
             # transfer, thesis §3.10).  Leader-only: elsewhere answers
             # 421 + X-Raft-Leader like linearizable reads.
-            if self.path not in ("/members", "/transfer"):
+            if self.path not in ("/members", "/transfer", "/reshard"):
                 self._method_not_allowed()
                 return
             try:
                 req = json.loads(self._body() or "{}")
+                if self.path == "/reshard":
+                    # Elastic-keyspace verb: {"verb": "split|merge|
+                    # migrate", "src": g, "dst": g|peer, "slots":
+                    # [..]?}.  One verb in flight: busy answers 409;
+                    # no plane compiled in answers 503.
+                    if rdb.reshard is None:
+                        self._send(503,
+                                   b"no reshard plane (--reshard)\n")
+                        return
+                    from raftsql_tpu.reshard.coordinator import (
+                        ReshardRefused)
+                    try:
+                        got = rdb.reshard.enqueue(
+                            str(req.get("verb", "")),
+                            int(req.get("src", -1)),
+                            int(req.get("dst", -1)),
+                            req.get("slots"))
+                    except ReshardRefused as e:
+                        self._send(409, (str(e) + "\n").encode())
+                        return
+                    self._send(200, (json.dumps(got, sort_keys=True)
+                                     + "\n").encode(),
+                               ctype="application/json")
+                    return
                 if self.path == "/transfer":
                     got = rdb.transfer(int(req.get("group", 0)),
                                        int(req.get("target", -1)))

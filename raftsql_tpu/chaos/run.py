@@ -405,6 +405,103 @@ def run_transfers(seed: int, runs: int = 2,
     return 0 if ok else 1
 
 
+def _run_reshard(plan) -> dict:
+    from raftsql_tpu.chaos.scenarios import ReshardChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return ReshardChaosRunner(plan, d).run()
+
+
+def run_reshard(seed: int, runs: int = 2) -> int:
+    """`make chaos-reshard`: the elastic-keyspace gauntlet.
+
+    1. The reshard nemesis (schedule.py generate_reshard), run twice —
+       seeded split/merge/migrate schedules race partitions, drops,
+       whole-cluster crash+restart, coordinator SIGKILL mid-verb and a
+       disk fault on the migrate snapshot ship, under live acked-PUT
+       load; schedule + result digests must reproduce and the
+       NoAckedWriteLost / NoAvailabilityLoss invariants (plus the
+       standing election-safety / durability / linearizability suite)
+       must hold.  The schedule is REQUIRED to exercise every verb,
+       at least one coordinator kill+recovery, and the fork-fault
+       abort path.
+    2. The FALSIFICATION pair (schedule.py falsification_reshard_plan):
+       a coordinator variant that flips the router BEFORE the
+       destination group durably applied the copied rows MUST be
+       caught by NoAckedWriteLost on a directed schedule (the copy
+       path starved by a partition anchored on the destination's
+       leader), and the SAME schedule with the correct coordinator
+       must complete the split cleanly — proving the harness detects
+       exactly the premature flip, not chaos in general.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    reports = []
+    for run in range(runs):
+        r = _run_reshard(S.generate_reshard(seed))
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(r["reshard_splits"] >= 1
+                     and r["reshard_merges"] >= 1
+                     and r["reshard_migrations"] >= 1,
+                     f"reshard: a verb family never completed ({r})")
+        ok &= _check(r["coordinator_kills"] >= 1
+                     and r["reshard_resumed"] >= 1,
+                     f"reshard: no SIGKILL+journal-recovery cycle ({r})")
+        ok &= _check(r["fork_faults"] >= 1
+                     and r["reshard_aborted"] >= 1,
+                     f"reshard: the disk-fault abort path never fired "
+                     f"({r})")
+    digests = {(r["schedule_digest"], r["result_digest"])
+               for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"reshard: non-reproducible: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_reshard(
+                    S.falsification_reshard_plan(seed, broken=True))
+            except InvariantViolation as e:
+                caught = "NO-ACKED-WRITE-LOST" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the BROKEN premature router "
+                         "flip was NOT caught by NoAckedWriteLost")
+    try:
+        r = _run_reshard(S.falsification_reshard_plan(seed,
+                                                      broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the CORRECT "
+                           f"coordinator tripped the invariant: {e}")
+    else:
+        ok &= _check(r["reshard_splits"] >= 1,
+                     "falsification control: the directed split never "
+                     "completed")
+        print(json.dumps(
+            {"falsification_control": "passed",
+             "moved_checks": r["moved_checks"]}))
+    if ok:
+        print(f"chaos reshard ok: seed={seed} "
+              f"schedule={reports[0]['schedule_digest']} "
+              f"result={reports[0]['result_digest']} "
+              f"falsification=caught")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -458,6 +555,11 @@ def main(argv=None) -> int:
                          " the fused transfer-under-nemesis family run "
                          "twice + the broken-kernel falsification pair "
                          "+ the process-plane POST /transfer nemesis")
+    ap.add_argument("--reshard", action="store_true",
+                    help="elastic-keyspace nemesis (make chaos-reshard)"
+                         ": seeded split/merge/migrate schedules under "
+                         "fire, run twice + the premature-router-flip "
+                         "falsification pair")
     ap.add_argument("--no-procs", action="store_true",
                     help="with --reads/--transfers: skip the "
                          "process-plane leg")
@@ -473,6 +575,8 @@ def main(argv=None) -> int:
     if args.transfers:
         return run_transfers(args.seed, runs=args.runs,
                              with_procs=not args.no_procs)
+    if args.reshard:
+        return run_reshard(args.seed, runs=args.runs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

@@ -306,6 +306,15 @@ class SQLiteStateMachine:
             out.append("|" + "|".join(_cell(v) for v in row) + "|\n")
         return "".join(out)
 
+    def rows(self, q: str) -> list:
+        """Structured read: the raw result tuples.  The reshard plane
+        moves row values between groups verbatim, so it cannot use
+        query()'s pipe-delimited rendering (a value containing '|'
+        would be torn on re-parse)."""
+        with self._lock:
+            cur = self._conn.execute(q)
+            return cur.fetchall()
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()

@@ -256,6 +256,11 @@ class RaftDB:
         # the server's --placement flag; None keeps metrics() and
         # flight bundles unchanged.
         self.placement = None
+        # Reshard plane (raftsql_tpu/reshard/plane.py), attached by the
+        # server's --reshard flag: the elastic-keyspace coordinator +
+        # keymap router.  None keeps /kv, /healthz and metrics()
+        # unchanged (the plane compiles in but stays idle).
+        self.reshard = None
         # propose→commit (stamped when the committed entry reaches the
         # apply consumer — commit + publish, before apply): the
         # histogram /metrics exports as propose_commit_p50/p95/p99_ms.
@@ -536,6 +541,14 @@ class RaftDB:
             if not cbs:
                 del self._q2cb[(group, query)]
 
+    def pending_for(self, group: int) -> int:
+        """Acks still outstanding for `group` — the reshard drain gate:
+        a frozen slot's verb may not copy rows until every write that
+        was in flight at freeze time either acked or errored."""
+        with self._mu:
+            return sum(len(d) for (g, _q), d in self._q2cb.items()
+                       if g == group)
+
     def watermark(self, group: int = 0) -> int:
         """This replica's applied index for `group` — the session
         watermark echoed as X-Raft-Session on both HTTP planes.  A
@@ -743,6 +756,10 @@ class RaftDB:
         # + issue counters, when a controller is attached.
         if self.placement is not None:
             m["placement"] = self.placement.metrics_doc()
+        # Reshard plane (raftsql_tpu/reshard/): verb counters, per-verb
+        # duration histogram, mapping epoch + active-verb gauge.
+        if self.reshard is not None:
+            m["reshard"] = self.reshard.metrics_doc()
         gcw = getattr(node, "_gcwal", None)
         if gcw is not None:
             # Group-commit batch histogram: peers coalesced per fsync
@@ -841,8 +858,14 @@ class RaftDB:
                 if lease_fn is not None:
                     row["lease_s"] = round(
                         max(lease_fn(g) - now, 0.0), 4)
-        return {"id": int(getattr(node, "node_id", 0)),
-                "ready": True, "groups": groups}
+        doc = {"id": int(getattr(node, "node_id", 0)),
+               "ready": True, "groups": groups}
+        # Elastic keyspace (raftsql_tpu/reshard/): the versioned
+        # key->group mapping.  Clients cache this and fail closed when
+        # a /kv response reports a newer epoch.
+        if self.reshard is not None:
+            doc["keymap"] = self.reshard.keymap.to_doc()
+        return doc
 
     def render_health(self) -> str:
         return json.dumps(self.health_doc(), sort_keys=True) + "\n"

@@ -345,8 +345,27 @@ class RaftNode:
             # (survivors can then never converge;
             # tests/test_node_loop.py::test_replay_publishes_only_committed_prefix).
             self._applied[g] = min(gl.log_len, gl.hard.commit)
+            if gl.dedup is not None:
+                # Seed the dedup window from the persisted baseline
+                # (storage/wal.py REC_DEDUP) BEFORE replay publishes the
+                # retained suffix: the suffix may hold a forward-retry
+                # duplicate whose first copy was compacted below the
+                # floor — live peers scrub it from their in-memory
+                # windows; without the baseline a restarted node would
+                # re-apply it and diverge (the snapshot-family chaos
+                # sweep caught exactly this).  _decode_entry then layers
+                # the above-floor pids on top in index order.
+                self._dedup[g].restore(gl.dedup[1])
         self._replay_groups = groups
         self.wal = WAL(data_dir, segment_bytes=cfg.wal_segment_bytes)
+        # Re-seed the fresh handle's dedup baseline (it survives only
+        # in-memory per handle, like the conf baseline — which
+        # _patch_group_config re-seeds the same way): without this, the
+        # first segment unlink after a restart could drop the replayed
+        # REC_DEDUP record before any new compaction re-writes it.
+        for g, gl in groups.items():
+            if gl.dedup is not None:
+                self.wal.set_dedup(g, gl.dedup[0], gl.dedup[1])
         # Dynamic membership (raftsql_tpu/membership/): always on — a
         # follower must recognize a conf entry the moment the first one
         # ever commits.  Restore the active config from the WAL: the
@@ -1056,6 +1075,15 @@ class RaftNode:
                 floor = min(applied.get(g, 0), commit,
                             int(self._applied[g])) - keep
                 if floor > self.payload_log.start(g):
+                    # Persist the dedup window at the new floor FIRST:
+                    # the pids at or below it become unrecoverable from
+                    # the log the moment the prefix drops, and a replay
+                    # without them re-applies any forward-retry
+                    # duplicate retained above the floor (REC_DEDUP,
+                    # storage/wal.py).  Rides the compaction barrier
+                    # (wal.compact syncs after its markers).
+                    self.wal.set_dedup(
+                        g, floor, self._dedup[g].pairs_upto(floor))
                     self.payload_log.compact(
                         g, floor, self.payload_log.term_of(g, floor))
                     changed = True
@@ -1446,6 +1474,11 @@ class RaftNode:
             with self._wal_lock:
                 self.payload_log.reset(g, rec.last_idx, rec.last_term)
                 self.wal.set_snapshot(g, rec.last_idx, rec.last_term)
+                if pairs is not None:
+                    # The adopted window must survive a restart too: the
+                    # skipped log range below the install boundary can
+                    # hold first copies of duplicates retained above it.
+                    self.wal.set_dedup(g, rec.last_idx, pairs)
                 self.wal.sync()
                 self.state = install_snapshot_state(
                     self.state, g, rec.last_idx, rec.last_term, rec.term)

@@ -77,7 +77,7 @@ _WRAP = 0xFFFFFFFF
 _REQ = struct.Struct("<BQIBQ")        # op, req_id, group, flags, token
 _CPL = struct.Struct("<QBI")          # req_id, status, leader
 
-OP_PUT, OP_GET, OP_DOC, OP_MEMBER, OP_XFER = 1, 2, 3, 4, 5
+OP_PUT, OP_GET, OP_DOC, OP_MEMBER, OP_XFER, OP_RESHARD = 1, 2, 3, 4, 5, 6
 ST_OK, ST_ERR, ST_NOT_LEADER, ST_UNAVAILABLE = 0, 1, 2, 3
 
 DEFAULT_RING_BYTES = 4 << 20
@@ -646,6 +646,32 @@ class RingServer:
 
         self._read_pool.submit(_run)
 
+    def _handle_reshard(self, worker: int, req_id: int,
+                        body: bytes) -> None:
+        """POST /reshard over the ring (op 6): enqueue an elastic-
+        keyspace verb at the engine's reshard plane.  Busy (one verb
+        in flight) and no-plane refusals surface as ST_ERR text the
+        worker maps back onto 409/503."""
+        def _run():
+            try:
+                if self.rdb.reshard is None:
+                    raise ValueError("no reshard plane (--reshard)")
+                req = json.loads(body.decode("utf-8") or "{}")
+                got = self.rdb.reshard.enqueue(
+                    str(req.get("verb", "")),
+                    int(req.get("src", -1)),
+                    int(req.get("dst", -1)),
+                    req.get("slots"))
+            except Exception as e:                      # noqa: BLE001
+                self._complete(worker, req_id, ST_ERR, 0,
+                               self._err_body(e))
+            else:
+                self._complete(worker, req_id, ST_OK, 0,
+                               (json.dumps(got, sort_keys=True) + "\n")
+                               .encode("utf-8"))
+
+        self._read_pool.submit(_run)
+
     # -- the drain loop --------------------------------------------------
 
     def _drain(self, worker: int) -> None:
@@ -675,6 +701,8 @@ class RingServer:
                         self._handle_member(worker, req_id, body)
                     elif op == OP_XFER:
                         self._handle_transfer(worker, req_id, body)
+                    elif op == OP_RESHARD:
+                        self._handle_reshard(worker, req_id, body)
                     else:
                         self._complete(worker, req_id, ST_ERR, 0,
                                        f"unknown op {op}".encode())
@@ -778,7 +806,7 @@ class RingClient:
 
     _OP_NAMES = {OP_PUT: "ring.put", OP_GET: "ring.get",
                  OP_DOC: "ring.doc", OP_MEMBER: "ring.member",
-                 OP_XFER: "ring.transfer"}
+                 OP_XFER: "ring.transfer", OP_RESHARD: "ring.reshard"}
 
     def _submit(self, op: int, group: int, flags: int, token: int,
                 body: bytes, deadline_s: float = 2.0) -> "RingFuture":
@@ -951,6 +979,19 @@ class RingClient:
             return json.loads(body.decode("utf-8"))
         if status == ST_NOT_LEADER:
             raise NotLeaderError(group, leader)
+        raise ValueError(body.decode("utf-8", "replace"))
+
+    def reshard(self, verb: str, src: int, dst: int,
+                slots=None) -> dict:
+        """POST /reshard over the ring (op 6): enqueue an elastic-
+        keyspace verb — same surface as ReshardPlane.enqueue."""
+        fut = self._submit(OP_RESHARD, 0, 0, 0,
+                           json.dumps({"verb": verb, "src": src,
+                                       "dst": dst,
+                                       "slots": slots}).encode())
+        status, _leader, body = fut.wait_raw(10.0)
+        if status == ST_OK:
+            return json.loads(body.decode("utf-8"))
         raise ValueError(body.decode("utf-8", "replace"))
 
     def _doc(self, name: str, timeout: float = 5.0) -> str:

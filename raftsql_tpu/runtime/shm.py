@@ -75,12 +75,15 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 # Header: magic, version, flags, num_groups, epoch, seq, log_head,
-# log_cap, pub_ns.  64 bytes with padding to keep the group table
-# aligned.
+# log_cap, pub_ns, keymap_epoch.  64 bytes with padding to keep the
+# group table aligned.  keymap_epoch (hdr[9]) is the elastic-keyspace
+# mapping version (raftsql_tpu/reshard/): a worker serving shm reads
+# under a routing table older than the publisher's FAILS CLOSED to the
+# ring path until it refreshes its mapping.
 _MAGIC = 0x534E4150                      # "SNAP"
 _VERSION = 1
 _FLAG_LOG_FULL = 1
-_HDR = struct.Struct("<IHHIQQQQQ")       # 48 bytes used
+_HDR = struct.Struct("<IHHIQQQQQQ")      # 60 bytes used
 _HDR_SIZE = 64
 # Per-group row: applied, commit, base_index, lease_deadline_ns,
 # leader (1-based, 0 unknown), pad.
@@ -139,6 +142,7 @@ class ShmSnapshotPublisher:
         self._log_cap = size - self._log_off
         self._full = False
         self.epoch = secrets.randbits(63) | 1    # never 0
+        self.keymap_epoch = 0      # elastic-keyspace mapping version
         self._rows = [[0, 0, 0, 0, 0] for _ in range(num_groups)]
         #             applied, commit, base_index, lease_ns, leader
         # Deltas arriving before start() buffer here: the log must
@@ -154,7 +158,8 @@ class ShmSnapshotPublisher:
         flags = _FLAG_LOG_FULL if self._full else 0
         self._mm[0:_HDR.size] = _HDR.pack(
             _MAGIC, _VERSION, flags, self.num_groups, self.epoch,
-            self._seq, self._log_head, self._log_cap, pub_ns)
+            self._seq, self._log_head, self._log_cap, pub_ns,
+            self.keymap_epoch)
 
     def _write_table(self) -> None:
         off = self._table_off
@@ -285,6 +290,14 @@ class ShmSnapshotPublisher:
                     row[3] = 0               # fail closed, keep going
             self._publish_locked(self._write_table)
 
+    def set_keymap_epoch(self, epoch: int) -> None:
+        """Publish a new elastic-keyspace mapping version (reshard
+        plane router flip).  Workers attached at an older value fail
+        their shm reads closed until they refresh the mapping."""
+        with self._lock:
+            self.keymap_epoch = int(epoch)
+            self._publish_locked(lambda: None)
+
     def close(self) -> None:
         with self._lock:
             try:
@@ -333,6 +346,11 @@ class ShmSnapshotReader:
             raise RuntimeError(f"{self.path}: bad snapshot header")
         self.epoch = hdr[4]
         self.num_groups = hdr[3]
+        # Elastic-keyspace mapping version this worker routes by.
+        # try_read fails closed while the publisher's header reports a
+        # different value; note_keymap_epoch revalidates after the
+        # worker refreshed its key->group mapping.
+        self._kmap_epoch = hdr[9]
         self._table_off = _HDR_SIZE
         self._log_off = _HDR_SIZE + self.num_groups * _ROW_SIZE
         self._replicas: Dict[int, _GroupReplica] = {}
@@ -423,6 +441,12 @@ class ShmSnapshotReader:
         if hdr[2] & _FLAG_LOG_FULL:
             self._dead = True                # overflow: permanently out
             return None
+        if hdr[9] != self._kmap_epoch:
+            # The router moved the keyspace (reshard flip) under this
+            # worker's cached mapping: fail closed to the ring path —
+            # the engine routes by the CURRENT mapping — until the
+            # worker refreshes and calls note_keymap_epoch.
+            return None
         if not 0 <= group < self.num_groups:
             return None
         applied, commit, _base, lease_ns, _leader, _pad = rows[group]
@@ -474,6 +498,17 @@ class ShmSnapshotReader:
         if snap is None or not 0 <= group < self.num_groups:
             return 0
         return int(snap[1][group][4])
+
+    def keymap_epoch(self) -> int:
+        """The publisher's CURRENT elastic-keyspace mapping version
+        (0 when no reshard plane ever published)."""
+        hdr = self._read_header_raw()
+        return int(hdr[9]) if hdr is not None else 0
+
+    def note_keymap_epoch(self, epoch: int) -> None:
+        """The worker refreshed its key->group mapping to `epoch`
+        (from /healthz): shm reads revalidate against it."""
+        self._kmap_epoch = int(epoch)
 
     def close(self) -> None:
         try:

@@ -355,6 +355,17 @@ def main(argv=None) -> None:
     ap.add_argument("--placement-imbalance", type=float, default=2.0,
                     help="hottest/coldest per-peer load ratio that "
                          "triggers a transfer")
+    ap.add_argument("--reshard", action="store_true",
+                    help="elastic keyspace (raftsql_tpu/reshard/): a "
+                         "coordinator thread executes SPLIT / MERGE / "
+                         "MIGRATE verbs (POST /reshard) journaled "
+                         "through the raft logs, and the keyed "
+                         "PUT/GET /kv/<key> surface routes by the "
+                         "versioned hash-slot keymap (clients fail "
+                         "closed on X-Raft-Keymap-Epoch mismatch)")
+    ap.add_argument("--reshard-nslots", type=int, default=64,
+                    help="hash slots in the key->group map (crc32 "
+                         "%% nslots; fixed for the cluster's life)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -421,6 +432,13 @@ def main(argv=None) -> None:
             imbalance=args.placement_imbalance)
         rdb.placement = pc
         pc.start()
+    if args.reshard:
+        from raftsql_tpu.reshard.plane import ReshardPlane
+        plane = ReshardPlane(rdb, nslots=args.reshard_nslots)
+        plane.start()        # recovers the journal fold, then drives
+        if rdb.placement is not None:
+            # split-hottest / merge-coldest verbs ride the controller.
+            rdb.placement.reshard = plane
     if args.workers > 0:
         _serve_workers(rdb, args)
         return

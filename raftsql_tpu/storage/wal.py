@@ -73,6 +73,8 @@ _EPOCH = struct.Struct("<BBQ")       # type, kind (0 BEGIN / 1 END), no
 _CONFREC = struct.Struct("<BIQBQQQ")  # type, group, index, kind,
 #                                       voters, joint, learners (u64
 #                                       slot bitmasks — membership/)
+_DEDUPHDR = struct.Struct("<BIQI")   # type, group, floor_index, count
+_DEDUPPAIR = struct.Struct("<QQ")    # applied index, proposal id
 
 REC_ENTRY = 1
 REC_HARDSTATE = 2
@@ -97,6 +99,19 @@ REC_CONF = 7            # applied membership configuration baseline
                         # re-applies any conf ENTRIES committed above
                         # it — so the active config survives even after
                         # compaction unlinks the entries that built it.
+REC_DEDUP = 8           # forward-retry dedup baseline (set_dedup): the
+                        # group's (applied_index, proposal_id) window
+                        # pairs at or below a compaction/install floor.
+                        # The dedup decision is a pure function of the
+                        # committed log PREFIX (runtime/envelope.py) —
+                        # compaction drops that prefix, so without this
+                        # record a restarted node replays only the
+                        # retained suffix and re-applies a forward-retry
+                        # duplicate whose first copy fell below the
+                        # floor while live peers scrub it (divergence).
+                        # Replay keeps the highest-floor record per
+                        # group; boot restores it into the DedupWindow
+                        # BEFORE publishing the retained suffix.
 
 _SEG_RE = re.compile(r"^wal-(\d+)\.log$")
 # Single source of truth for the default lives in config (the CLI and
@@ -145,6 +160,9 @@ class GroupLog:
     # Last applied-membership baseline (REC_CONF), or None:
     # (entry_index, kind, voters_mask, joint_mask, learners_mask).
     conf: Optional[Tuple[int, int, int, int, int]] = None
+    # Highest-floor dedup baseline (REC_DEDUP), or None:
+    # (floor_index, [(applied_index, proposal_id), ...] FIFO order).
+    dedup: Optional[Tuple[int, List[Tuple[int, int]]]] = None
 
     @property
     def log_len(self) -> int:
@@ -305,6 +323,10 @@ class WAL:
         # states.  Seeded by the owning runtime after replay (set_conf
         # is idempotent), not by this handle.
         self._conf_latest: Dict[int, Tuple[int, int, int, int, int]] = {}
+        # Latest dedup baseline per group (set_dedup), kept as the
+        # packed record body so compaction's re-assert is a plain
+        # re-append — same survival contract as _conf_latest.
+        self._dedup_latest: Dict[int, bytes] = {}
         self._open_active()
 
     @staticmethod
@@ -597,6 +619,31 @@ class WAL:
                                   voters, joint, learners))
         return True
 
+    def set_dedup(self, group: int, floor: int,
+                  pairs: List[Tuple[int, int]]) -> bool:
+        """Dedup-window baseline record (REC_DEDUP): `pairs` is the
+        group's forward-retry window at or below `floor` (the new
+        compaction/install boundary), FIFO order.  Replay keeps the
+        highest-floor record; node boot restores it into the in-memory
+        window before publishing the retained suffix, so a restart
+        scrubs the same forward-retry duplicates its live peers do.
+
+        Durability ride-along like set_conf: the caller's compaction /
+        install barrier syncs it.  The native C fast path has no dedup
+        writer — returns False there (the chaos/fsio posture forces the
+        Python backend wherever this invariant is exercised; native
+        deployments keep the pre-record behavior and the documented
+        gap)."""
+        if self._lib is not None:
+            return False
+        body = b"".join(
+            [_DEDUPHDR.pack(REC_DEDUP, group, floor, len(pairs))]
+            + [_DEDUPPAIR.pack(i, p) for (i, p) in pairs])
+        self._dedup_latest[group] = body
+        self._active_stats.hs.add(group)   # re-assert like a hard state
+        self._write(body)
+        return True
+
     def epoch_mark(self, no: int, end: bool) -> None:
         """Multi-step dispatch frame marker (REC_EPOCH): BEGIN before
         the dispatch's first record, END after its last (including the
@@ -760,6 +807,9 @@ class WAL:
                 # baseline must be re-asserted before this segment may
                 # be unlinked (compact()'s _conf_latest re-write).
                 st.hs.add(_CONFREC.unpack_from(body)[1])
+            elif rtype == REC_DEDUP:
+                # Baseline survival contract, like REC_CONF above.
+                st.hs.add(_DEDUPHDR.unpack_from(body)[1])
             elif rtype in (REC_SNAPSHOT, REC_COMPACT):
                 _, group, index, _t = _SNAP.unpack_from(body)
                 st.bump(group, index)
@@ -829,6 +879,12 @@ class WAL:
                 # the conf ENTRY that built it may live only in the
                 # doomed segments.
                 self._write(_CONFREC.pack(REC_CONF, g, *conf))
+            dd = self._dedup_latest.get(g)
+            if dd is not None and self._lib is None:
+                # Likewise the dedup baseline: the doomed segments may
+                # hold the only record scrubbing a compacted-away
+                # forward-retry duplicate.
+                self._write(dd)
         self.sync()
         for path in run:
             os.unlink(path)
@@ -988,6 +1044,17 @@ class WAL:
                 # (runtime membership wiring).
                 if gl.conf is None or index >= gl.conf[0]:
                     gl.conf = (index, kind, voters, joint, learners)
+            elif rtype == REC_DEDUP:
+                _, group, floor, count = _DEDUPHDR.unpack_from(body)
+                gl = groups.setdefault(group, GroupLog())
+                # Highest-floor-wins dedup baseline (a later compaction
+                # supersedes an earlier one; pairs are FIFO-ordered).
+                if gl.dedup is None or floor >= gl.dedup[0]:
+                    off2 = _DEDUPHDR.size
+                    gl.dedup = (floor, [
+                        _DEDUPPAIR.unpack_from(
+                            body, off2 + k * _DEDUPPAIR.size)
+                        for k in range(count)])
         return True
 
 

@@ -1,0 +1,193 @@
+"""Zipfian split-hottest rebalancing demo (the elastic-keyspace bench).
+
+Boots the fused runtime with the reshard plane, drives a seeded
+zipfian keyed workload (a handful of keys carry most of the traffic,
+so one group runs hot), then lets the placement controller's
+`split_hottest` verb carve half of the hot group's hash slots out to
+the least-loaded group.  The same workload runs again under the new
+mapping and the before/after per-group traffic shares land as one
+JSON report in bench_logs/ — the acceptance artifact showing the
+keyspace actually rebalances under skew.
+
+Deterministic by construction (raftlint determinism scope covers
+scripts/): the load shape comes entirely from --seed, pacing from
+monotonic clocks, and the report carries no wall-clock timestamps.
+
+Usage:  python scripts/bench_reshard.py [--seed 0] [--out bench_logs/...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ACK_TIMEOUT_S = 10.0
+
+
+def _put(rdb, plane, key, value):
+    g, sql = plane.kv_put(key, value)
+    fut = rdb.propose(sql, g)
+    err = fut.wait(ACK_TIMEOUT_S)
+    if err is not None:
+        raise RuntimeError(f"put {key!r} refused: {err}")
+    return g
+
+
+def _zipf_keys(rng, nkeys, count, s=1.2):
+    """`count` seeded zipfian draws over `nkeys` distinct keys."""
+    weights = [1.0 / (r + 1) ** s for r in range(nkeys)]
+    keys = [f"user{r}" for r in range(nkeys)]
+    return rng.choices(keys, weights=weights, k=count)
+
+
+def _group_loads(plane, hits):
+    """Per-group PUT counts under the plane's CURRENT mapping."""
+    loads = {g: 0 for g in range(plane.db.num_groups)}
+    for k, n in hits.items():
+        loads[plane.keymap.group_of(k)] += n
+    return loads
+
+
+def _row_counts(plane):
+    out = {}
+    for g in range(plane.db.num_groups):
+        try:
+            rows = plane._rows(g, "SELECT count(*) FROM kv")
+            out[g] = int(rows[0][0])
+        except Exception:               # noqa: BLE001 - no kv table yet
+            out[g] = 0
+    return out
+
+
+def _share(loads):
+    total = sum(loads.values()) or 1
+    hot = max(loads.values())
+    return round(hot / total, 4)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--groups", type=int, default=4)
+    ap.add_argument("--nslots", type=int, default=32)
+    ap.add_argument("--keys", type=int, default=256)
+    ap.add_argument("--puts", type=int, default=800,
+                    help="PUTs per load phase")
+    ap.add_argument("--out", default=None,
+                    help="report path (default bench_logs/"
+                         "reshard_zipfian_s<seed>.json)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from raftsql_tpu.config import RaftConfig
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+    from raftsql_tpu.placement import PlacementController
+    from raftsql_tpu.reshard.plane import ReshardPlane
+    from raftsql_tpu.runtime.db import RaftDB
+    from raftsql_tpu.runtime.fused import FusedClusterNode, FusedPipe
+
+    tmp = tempfile.mkdtemp(prefix="bench-reshard-")
+    cfg = RaftConfig(num_groups=args.groups, num_peers=3,
+                     log_window=64, max_entries_per_msg=8,
+                     tick_interval_s=0.0)
+    node = FusedClusterNode(cfg, os.path.join(tmp, "data"))
+    node.start(interval_s=0.0005)
+    rdb = RaftDB(lambda g: SQLiteStateMachine(
+        os.path.join(tmp, f"g{g}.db")), pipe=FusedPipe(node),
+        num_groups=args.groups)
+    plane = ReshardPlane(rdb, nslots=args.nslots,
+                         ship_dir=os.path.join(tmp, "ship"))
+    pc = PlacementController(node)          # not started: we drive it
+    pc.reshard = plane
+    rdb.placement = pc
+
+    rng = random.Random(args.seed)
+    draws = _zipf_keys(rng, args.keys, args.puts)
+    hits = {}
+    for k in draws:
+        hits[k] = hits.get(k, 0) + 1
+
+    print(f"bench-reshard: seed={args.seed} G={args.groups} "
+          f"nslots={args.nslots} keys={args.keys} "
+          f"puts={args.puts}", flush=True)
+
+    # Phase 1: skewed load under the boot mapping.
+    t0 = time.monotonic()
+    for i, k in enumerate(draws):
+        _put(rdb, plane, k, f"s{args.seed}v{i}")
+    phase1_s = round(time.monotonic() - t0, 3)
+    before = {
+        "epoch": plane.keymap.epoch,
+        "group_puts": _group_loads(plane, hits),
+        "hot_share": _share(_group_loads(plane, hits)),
+        "rows": _row_counts(plane),
+    }
+    print(f"  before: hot_share={before['hot_share']} "
+          f"puts/group={before['group_puts']}", flush=True)
+
+    # The controller carves half the hottest group's slots out.
+    doc = pc.split_hottest()
+    if doc is None:
+        raise RuntimeError(f"split_hottest refused: {pc.__dict__}")
+    deadline = time.monotonic() + 60.0
+    while plane.coord.busy:
+        plane.step()
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"split stuck: {plane.doc()}")
+        time.sleep(0.002)
+    verb = {"verb": doc["verb"], "src": doc["src"], "dst": doc["dst"],
+            "epoch": plane.keymap.epoch,
+            "counters": dict(plane.coord.counters)}
+    print(f"  split: {doc['src']} -> {doc['dst']} "
+          f"epoch={plane.keymap.epoch}", flush=True)
+
+    # Phase 2: the SAME skewed load under the new mapping.
+    t0 = time.monotonic()
+    for i, k in enumerate(draws):
+        _put(rdb, plane, k, f"s{args.seed}w{i}")
+    phase2_s = round(time.monotonic() - t0, 3)
+    after = {
+        "epoch": plane.keymap.epoch,
+        "group_puts": _group_loads(plane, hits),
+        "hot_share": _share(_group_loads(plane, hits)),
+        "rows": _row_counts(plane),
+    }
+    print(f"  after:  hot_share={after['hot_share']} "
+          f"puts/group={after['group_puts']}", flush=True)
+
+    report = {
+        "bench": "reshard_zipfian_split_hottest",
+        "seed": args.seed, "groups": args.groups,
+        "nslots": args.nslots, "keys": args.keys, "puts": args.puts,
+        "zipf_s": 1.2,
+        "before": before, "verb": verb, "after": after,
+        "phase_seconds": [phase1_s, phase2_s],
+        "improved": after["hot_share"] < before["hot_share"],
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_logs", f"reshard_zipfian_s{args.seed}.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"bench-reshard: report -> {out} "
+          f"(hot_share {before['hot_share']} -> "
+          f"{after['hot_share']})", flush=True)
+
+    rdb.close()
+    if not report["improved"]:
+        print("bench-reshard: WARNING: hot share did not improve",
+              flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,7 +236,7 @@ def lint_fused_server(engine: str) -> None:
         [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
          "--port", str(port), "--groups", "2", "--tick", "0.005",
          "--http-engine", engine, "--placement",
-         "--placement-interval", "0.2"],
+         "--placement-interval", "0.2", "--reshard"],
         cwd=tmp, env=env, stdout=logf, stderr=logf)
     try:
         deadline = time.monotonic() + 90
@@ -278,10 +278,25 @@ def lint_fused_server(engine: str) -> None:
             assert put(f"INSERT INTO t (v) VALUES ('{i}')",
                        i % 2) == 204
         lint_url("127.0.0.1", port, label=f"fused/{engine}",
-                 extra_required=("raftsql_placement_issued",
-                                 "raftsql_placement_refused",
-                                 "raftsql_placement_last_imbalance",
-                                 "raftsql_placement_backoff_groups"))
+                 extra_required=(
+                     "raftsql_placement_issued",
+                     "raftsql_placement_refused",
+                     "raftsql_placement_last_imbalance",
+                     "raftsql_placement_backoff_groups",
+                     # Elastic keyspace (raftsql_tpu/reshard/): verb
+                     # counters, mapping epoch, and the per-verb
+                     # duration histograms — present (0) from boot so
+                     # dashboards can rate() them unconditionally.
+                     "raftsql_reshard_splits",
+                     "raftsql_reshard_merges",
+                     "raftsql_reshard_migrations",
+                     "raftsql_reshard_aborted",
+                     "raftsql_reshard_resumed",
+                     "raftsql_reshard_epoch",
+                     "raftsql_reshard_active",
+                     "raftsql_reshard_duration_split_count",
+                     "raftsql_reshard_duration_merge_count",
+                     "raftsql_reshard_duration_migrate_count"))
     finally:
         proc.terminate()
         try:

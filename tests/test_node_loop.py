@@ -178,3 +178,59 @@ def test_forward_reclaimed_when_follower_becomes_leader(tmp_path):
                 n.stop()
         if dead is not None:
             dead.stop()
+
+
+def test_replay_scrubs_duplicate_whose_first_copy_was_compacted(tmp_path):
+    """fail-before/pass-after (found by the snapshot-family chaos seed
+    sweep, seed 2): the dedup decision is a pure function of the
+    committed log PREFIX — but compaction drops that prefix, so a
+    restarted node replaying only the retained suffix used to re-apply
+    a forward-retry duplicate whose first copy fell below the floor,
+    while its live peers (in-memory windows intact) scrubbed it:
+    permanent divergence.  The REC_DEDUP baseline persisted at the
+    compaction boundary (storage/wal.py) must make replay scrub the
+    same duplicates the live peers do."""
+    from raftsql_tpu.runtime.envelope import wrap
+    from raftsql_tpu.storage.wal import WAL
+
+    DUP_PID = 42
+
+    def make_wal(d, with_baseline):
+        # Floor at 2: the duplicate's first copy (applied at index 1)
+        # is gone.  The retained suffix holds its re-proposed copy at
+        # index 3 plus an ordinary entry at 4; both are committed.
+        w = WAL(str(d), native=False)
+        w.mark_compact(0, 2, 1)
+        if with_baseline:
+            assert w.set_dedup(0, 2, [(1, DUP_PID)])
+        w.append_entry(0, 3, 1, wrap(b"SET k stale-dup", pid=DUP_PID))
+        w.append_entry(0, 4, 1, wrap(b"SET k fresh", pid=77))
+        w.set_hardstate(0, 1, NO_VOTE, 4)
+        w.sync()
+        w.close()
+
+    def replayed_sqls(d):
+        cfg = RaftConfig(num_groups=1, num_peers=1,
+                         tick_interval_s=0.002, log_window=32,
+                         max_entries_per_msg=4)
+        n = RaftNode(1, 1, cfg, LoopbackTransport(LoopbackHub()),
+                     data_dir=str(d))
+        sqls = []
+        try:
+            n.start(threaded=False)
+            while True:                 # replay ends with the sentinel
+                item = n.commit_q.get(timeout=5)
+                if item is None:
+                    break
+                sqls.append(item[2])
+        finally:
+            n.stop()
+        return sqls
+
+    make_wal(tmp_path / "bare", with_baseline=False)
+    make_wal(tmp_path / "pinned", with_baseline=True)
+    # Control: without the baseline the duplicate IS re-published —
+    # proving the assertion below bites.
+    assert replayed_sqls(tmp_path / "bare") == [
+        "SET k stale-dup", "SET k fresh"]
+    assert replayed_sqls(tmp_path / "pinned") == ["SET k fresh"]

@@ -195,6 +195,41 @@ class TestSegmentation:
         assert groups[1].start == 7
         assert groups[1].entries == []
 
+    def test_dedup_baseline_replay_highest_floor_wins(self, tmp_path):
+        """REC_DEDUP replay: the baseline comes back verbatim, and a
+        later (higher-floor) record supersedes an earlier one."""
+        d = str(tmp_path / "w")
+        w = WAL(d, native=False)
+        w.append_entry(0, 1, 1, b"e1")
+        assert w.set_dedup(0, 1, [(1, 42)])
+        w.append_entry(0, 2, 1, b"e2")
+        w.append_entry(0, 3, 1, b"e3")
+        assert w.set_dedup(0, 2, [(1, 42), (2, 77)])
+        w.sync()
+        w.close()
+        gl = WAL.replay(d)[0]
+        assert gl.dedup == (2, [(1, 42), (2, 77)])
+
+    def test_dedup_baseline_survives_segment_unlink(self, tmp_path):
+        """The dedup baseline obeys the hard-state survival contract:
+        compaction re-asserts it into the active segment before
+        unlinking the closed segment that held it — the doomed segment
+        may hold the only record scrubbing a compacted-away
+        forward-retry duplicate."""
+        d = str(tmp_path / "w")
+        w = WAL(d, native=False, segment_bytes=256)
+        w.append_entry(0, 1, 1, b"first-copy")
+        assert w.set_dedup(0, 1, [(1, 42)])
+        for i in range(2, 41):
+            w.append_entry(0, i, 1, f"e{i}".encode())
+            w.set_hardstate(0, 1, -1, i)
+            w.sync()
+        assert w.compact({0: (30, 1)}, {0: (1, -1, 40)}) > 0
+        w.close()
+        gl = WAL.replay(d)[0]
+        assert gl.start == 30
+        assert gl.dedup == (1, [(1, 42)])
+
     def test_torn_mid_sequence_drops_later_segments(self, tmp_path):
         """A tear in a non-final segment is real corruption: replay keeps
         only the clean prefix, never skips over the damage."""

@@ -283,3 +283,44 @@ def test_transfer_plan_digests_are_stable():
     assert db == dc
     ev = broken.transfers[0]
     assert ev.must_complete and ev.tick == broken.partitions[0].end
+
+
+def test_split_hottest_partitions_by_slot_traffic():
+    """The split verb must divide the hot group's observed LOAD, not
+    its slot count: count-halving under a skewed workload can hand the
+    hot slots themselves to dst, crowning it the new hottest group
+    (scripts/bench_reshard.py demonstrates the regression end to
+    end)."""
+    from raftsql_tpu.placement.controller import PlacementController
+    from raftsql_tpu.reshard.keymap import KeyMap
+
+    class _FakePlane:
+        def __init__(self, km):
+            self.keymap = km
+            self.slot_hits = [0] * km.nslots
+            self.calls = []
+
+        def enqueue(self, verb, src, dst, slots=None):
+            self.calls.append((verb, src, dst, list(slots)))
+            return {"verb": verb, "src": src, "dst": dst,
+                    "slots": list(slots)}
+
+    # Group 0 owns slots 0,2,4,6 (stripe of G=2 over 8 slots) and is
+    # the rate-EWMA hottest; slot 0 carries most of its traffic.
+    eng = _FakeEngine(leaders=[0, 0], rates=[90, 5])
+    pc = PlacementController(eng)
+    plane = _FakePlane(KeyMap.initial(2, nslots=8))
+    pc.reshard = plane
+    plane.slot_hits[0] = 100
+    plane.slot_hits[2] = 10
+    plane.slot_hits[4] = 6
+    plane.slot_hits[6] = 5
+    doc = pc.split_hottest()
+    assert doc is not None and plane.calls == [("split", 0, 1, [2, 4, 6])]
+    # The hot slot STAYS with src: src keeps ~100 hits, dst gets ~21.
+
+    # Without a per-slot signal the verb falls back to count-halving.
+    plane.calls.clear()
+    plane.slot_hits = [0] * 8
+    assert pc.split_hottest() is not None
+    assert plane.calls == [("split", 0, 1, [0, 2])]
