@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh chaos-reads chaos-transfer chaos-reshard trace prom-lint clean
+	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -137,6 +137,25 @@ chaos-transfer:
 chaos-reshard:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --reshard --seed $(SEED)
+
+# Quorum-geometry nemesis (raftsql_tpu/chaos/): flexible write /
+# election quorums and witness peers under fire.  The witness-cluster
+# family (2 full voters + 1 witness, W=E=2) runs twice and is
+# digest-compared — the witness must replicate (witness_appends) but
+# never publish, with exactly one apply/shard stream fewer than WAL
+# streams — then TWO falsification pairs: (a) a non-intersecting
+# W=1/E=2 geometry (config-refused without unsafe_quorum_geometry;
+# asserted) MUST be caught as divergent committed slots when a
+# partitioned pinned leader solo-commits against the majority's
+# rewrite, and the same schedule at W=2 must pass; (b) a witness
+# wrongly counted toward the LEASE quorum (unsafe_witness_lease) MUST
+# be caught as a stale lease read when it grants a prevote inside the
+# deposed leader's live lease, and the honest witness must pass the
+# same schedule.
+#   make chaos-quorum SEED=17
+chaos-quorum:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --quorum --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

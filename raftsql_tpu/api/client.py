@@ -160,6 +160,10 @@ class RaftSQLClient:
         self._leader: Dict[int, int] = {}      # group -> node index
         self._lease: Dict[int, Tuple[int, float]] = {}
         #   group -> (node index, monotonic lease-hint expiry)
+        # Witness replicas (config.py quorum geometry): they accept
+        # forwarded writes like any follower but refuse every read
+        # (400), so the read rotation must never land on one.
+        self._witness: set = set()
         self._hints_at = 0.0                   # last /healthz sweep
         self._keymap: Optional[dict] = None    # elastic-keyspace doc
         self._rr = 0                           # round-robin cursor
@@ -209,10 +213,14 @@ class RaftSQLClient:
         raise AssertionError("unreachable")    # pragma: no cover
 
     def _order(self, group: int, node: Optional[int],
-               prefer: Optional[int] = None) -> List[int]:
+               prefer: Optional[int] = None,
+               for_read: bool = False) -> List[int]:
         """Attempt order: pinned node only, else `prefer` (a live lease
         hint) first, then cached leader, then round-robin over the
-        rest."""
+        rest.  `for_read` drops known witness replicas from the
+        rotation (they refuse every read with 400 — a terminal answer,
+        not a retry); a pinned node is the caller's explicit choice
+        and is honored either way."""
         if node is not None:
             return [node]
         n = len(self.nodes)
@@ -220,7 +228,10 @@ class RaftSQLClient:
             start = self._rr % n
             self._rr += 1
             lead = self._leader.get(group)
-        order = [(start + i) % n for i in range(n)]
+            skip = set(self._witness) if for_read else ()
+        order = [(start + i) % n for i in range(n)
+                 if (start + i) % n not in skip] \
+            or [(start + i) % n for i in range(n)]
         for front in (lead, prefer):
             if front is not None and front in order:
                 order.remove(front)
@@ -242,11 +253,16 @@ class RaftSQLClient:
         n = len(self.nodes)
         leaders: Dict[int, int] = {}
         leases: Dict[int, Tuple[int, float]] = {}
+        witnesses: set = set()
+        answered: set = set()
         now = time.monotonic()
         for idx in range(n):
             doc = self.health(idx, timeout_s=timeout_s)
             if not doc:
                 continue
+            answered.add(idx)
+            if doc.get("witness"):
+                witnesses.add(idx)
             for key, row in (doc.get("groups") or {}).items():
                 try:
                     g = int(key)
@@ -267,6 +283,11 @@ class RaftSQLClient:
         with self._mu:
             self._leader.update(leaders)
             self._lease.update(leases)
+            # Witness identity is static per process: only nodes that
+            # ANSWERED update their entry (an unreachable node keeps
+            # whatever the last sweep learned).
+            self._witness -= answered
+            self._witness |= witnesses
             self._hints_at = time.monotonic()
         return len(leaders)
 
@@ -419,7 +440,8 @@ class RaftSQLClient:
             # the read needs no quorum round at all (lease fast path).
             prefer = (self._lease_target(group)
                       if consistency == "linear" else None)
-            for idx in self._order(group, node, prefer=prefer):
+            for idx in self._order(group, node, prefer=prefer,
+                                   for_read=True):
                 try:
                     status, hdrs, text = self.raw(
                         idx, "GET", "/", sql, headers)
@@ -567,7 +589,7 @@ class RaftSQLClient:
                 headers["X-Consistency"] = consistency
             if session > 0:
                 headers["X-Raft-Session"] = str(session)
-            for idx in self._order(0, None):
+            for idx in self._order(0, None, for_read=True):
                 try:
                     status, hdrs, text = self.raw(
                         idx, "GET", path, "", headers)

@@ -77,6 +77,12 @@ def _run_reads(plan) -> dict:
         return ReadNemesisRunner(plan, d).run()
 
 
+def _run_quorum(plan) -> dict:
+    from raftsql_tpu.chaos.scenarios import QuorumChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return QuorumChaosRunner(plan, d).run()
+
+
 def _run_transfers(plan) -> dict:
     from raftsql_tpu.chaos.scenarios import TransferChaosRunner
     with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
@@ -145,6 +151,12 @@ def _family_specs():
                   and r["follower_reads"] > 0
                   and r["reads_by_mode"].get("linear", 0) > 0
                   and r["skew_ticks"] > 0 and r["crashes"] >= 1),
+        "quorum": (lambda seed: _run_quorum(S.generate_quorum(seed)),
+                   True, lambda r: r["witness_appends"] > 0
+                   and r["witness_publishes"] == 0
+                   and r["apply_streams"] == r["wal_streams"] - 1
+                   and r["lease_reads"] > 0 and r["crashes"] >= 1
+                   and r["partitions"] >= 1),
         "transfers": (lambda seed: _run_transfers(
                           S.generate_transfers(seed)),
                       True, lambda r: r["transfers_requested"] >= 6
@@ -502,6 +514,141 @@ def run_reshard(seed: int, runs: int = 2) -> int:
     return 0 if ok else 1
 
 
+def run_quorum(seed: int, runs: int = 2) -> int:
+    """`make chaos-quorum`: the quorum-geometry gauntlet.
+
+    1. The witness-cluster nemesis (schedule.py generate_quorum): two
+       full voters + one witness, W = E = 2 explicit, under
+       leader-targeted partitions, an asymmetric cut, clock skew and
+       whole-cluster crash+restart with acked PUTs and lease/ReadIndex
+       reads.  Run `runs` times — schedule + result digests must
+       reproduce, the witness must replicate (witness_appends > 0)
+       without ever publishing (witness_publishes == 0), and the
+       report must show exactly one apply/shard stream fewer than WAL
+       streams (the fsync economy the witness buys).
+    2. FALSIFICATION arm A — non-intersecting quorums.  First the
+       config gate: W=1/E=2 on 3 peers must be REFUSED without
+       unsafe_quorum_geometry.  Then the directed plan
+       (falsification_quorum_plan) with the gate bypassed: a
+       partitioned pinned leader solo-commits acked writes the
+       majority side then rewrites — the split MUST be caught
+       (cross-peer changed-content / log matching / commit
+       monotonicity / election safety).  The SAME schedule at W=2
+       must pass.
+    3. FALSIFICATION arm B — witness counted toward the lease quorum
+       (falsification_witness_plan): unsafe_witness_lease lets the
+       witness grant a prevote inside the deposed leader's live
+       lease; the new leader's committed write then makes the old
+       leader's lease read STALE, and the register invariant MUST
+       fire.  The SAME schedule with the honest witness must pass.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+    from raftsql_tpu.config import RaftConfig
+
+    ok = True
+    fired = _family_specs()["quorum"][2]
+    reports = []
+    for run in range(runs):
+        r = _run_quorum(S.generate_quorum(seed))
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(fired(r),
+                     f"quorum: a geometry signature never fired ({r})")
+    digests = {(r["plan_digest"], r["result_digest"])
+               for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"quorum: non-reproducible: {digests}")
+
+    # Arm A, config gate: the geometry the broken plan runs is refused
+    # at construction unless explicitly bypassed.
+    try:
+        RaftConfig(num_groups=1, num_peers=3,
+                   write_quorum=1, election_quorum=2)
+    except ValueError as e:
+        refused = "intersect" in str(e)
+        print(json.dumps({"geometry_guard": "refused",
+                          "error": str(e)}))
+    else:
+        refused = False
+    ok &= _check(refused, "quorum: W=1/E=2 on 3 peers was NOT refused "
+                          "at config time")
+
+    # Falsification sensitivity proofs.  Violations are EXPECTED —
+    # route their flight bundles to a temp dir instead of cwd.
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    caught_split = caught_stale = False
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_quorum(S.falsification_quorum_plan(seed,
+                                                        broken=True))
+            except InvariantViolation as e:
+                caught_split = any(
+                    m in str(e) for m in ("changed content",
+                                          "diverge", "regressed",
+                                          "two leaders"))
+                print(json.dumps({"falsification": "caught",
+                                  "arm": "non-intersecting",
+                                  "violation": str(e)}))
+            try:
+                _run_quorum(S.falsification_witness_plan(seed,
+                                                         broken=True))
+            except InvariantViolation as e:
+                caught_stale = "STALE" in str(e) or "stale" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "arm": "witness-lease",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught_split,
+                 "falsification: the NON-INTERSECTING W=1/E=2 "
+                 "geometry was NOT caught by any invariant")
+    ok &= _check(caught_stale,
+                 "falsification: the witness-in-lease-quorum bug was "
+                 "NOT caught as a stale lease read")
+    try:
+        r = _run_quorum(S.falsification_quorum_plan(seed,
+                                                    broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the CORRECT W=2 "
+                           f"geometry tripped the invariant: {e}")
+    else:
+        ok &= _check(r["committed_entries"] > 0,
+                     "falsification control: nothing committed under "
+                     "the correct geometry")
+        print(json.dumps({"falsification_control": "passed",
+                          "arm": "non-intersecting",
+                          "committed": r["committed_entries"]}))
+    try:
+        r = _run_quorum(S.falsification_witness_plan(seed,
+                                                     broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the HONEST "
+                           f"witness tripped the invariant: {e}")
+    else:
+        ok &= _check(r["lease_reads"] > 0,
+                     "falsification control: no lease reads granted "
+                     "under the honest witness")
+        print(json.dumps({"falsification_control": "passed",
+                          "arm": "witness-lease",
+                          "lease_reads": r["lease_reads"]}))
+    if ok:
+        print(f"chaos quorum ok: seed={seed} "
+              f"plan={reports[0]['plan_digest']} "
+              f"result={reports[0]['result_digest']} "
+              f"witness_appends={reports[0]['witness_appends']} "
+              f"apply_streams={reports[0]['apply_streams']}/"
+              f"{reports[0]['wal_streams']} falsification=caught(x2)")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -560,6 +707,11 @@ def main(argv=None) -> int:
                          ": seeded split/merge/migrate schedules under "
                          "fire, run twice + the premature-router-flip "
                          "falsification pair")
+    ap.add_argument("--quorum", action="store_true",
+                    help="quorum-geometry nemesis (make chaos-quorum):"
+                         " the witness-cluster family run twice + the "
+                         "non-intersecting-geometry and "
+                         "witness-lease falsification pairs")
     ap.add_argument("--no-procs", action="store_true",
                     help="with --reads/--transfers: skip the "
                          "process-plane leg")
@@ -577,6 +729,8 @@ def main(argv=None) -> int:
                              with_procs=not args.no_procs)
     if args.reshard:
         return run_reshard(args.seed, runs=args.runs)
+    if args.quorum:
+        return run_quorum(args.seed, runs=args.runs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

@@ -646,7 +646,22 @@ class ReadNemesisRunner(FusedChaosRunner):
                          election_ticks=plan.election_ticks,
                          heartbeat_ticks=1, tick_interval_s=0.0,
                          lease_ticks=plan.lease_ticks,
-                         max_clock_skew=plan.max_clock_skew)
+                         max_clock_skew=plan.max_clock_skew,
+                         # Quorum-geometry plans (QuorumNemesisPlan)
+                         # carry these; ReadNemesisPlan does not, and
+                         # the defaults leave the config on the static
+                         # full-voter fast path.
+                         write_quorum=getattr(plan, "write_quorum",
+                                              None),
+                         election_quorum=getattr(plan,
+                                                 "election_quorum",
+                                                 None),
+                         witnesses=getattr(plan, "witnesses",
+                                           None) or None,
+                         unsafe_quorum_geometry=getattr(
+                             plan, "unsafe_geometry", False),
+                         unsafe_witness_lease=getattr(
+                             plan, "broken_witness_lease", False))
         super().__init__(sched, data_dir, cfg=cfg)
         self.plan = plan
         P, G = plan.peers, plan.groups
@@ -785,6 +800,104 @@ class ReadNemesisRunner(FusedChaosRunner):
         r["session_reads_checked"] = self.session.reads_checked
         r["reads_by_mode"] = dict(sorted(
             self.lin.reads_by_mode.items()))
+        return r
+
+
+class QuorumChaosRunner(ReadNemesisRunner):
+    """The quorum-geometry nemesis (fused plane): flexible
+    write/election quorums and witness peers (config.py quorum
+    geometry) under the read-nemesis workload and fault families.
+    Extends ReadNemesisRunner with three quorum-specific checks:
+
+      * CROSS-PEER commit consistency: every peer's publish stream
+        feeds one shared DurabilityLedger keyed (group, index).  Under
+        an intersecting geometry (W + E > N) two peers can never
+        surface different payloads for one slot — raft's committed-
+        entry uniqueness.  The W=1 falsification plan
+        (schedule.py falsification_quorum_plan) makes a partitioned
+        pinned leader solo-commit acked writes the majority side then
+        rewrites; the divergence MUST be caught (this ledger's
+        changed-content check, or log matching / commit monotonicity
+        if they observe the split first).
+      * WITNESS serving audit: a witness's publish stream must stay
+        EMPTY (runtime/hostplane.py advances its cursor without
+        publishing — it has no apply plane); any payload surfacing
+        from a witness is counted in `witness_publishes` and failed by
+        the run driver.  The report carries `wal_streams` (every peer
+        fsyncs a WAL) vs `apply_streams` (only non-witness peers apply
+        — the fsync stream the witness economy saves) and the
+        witness's replicated-append count (`witness_appends`, summed
+        across crash/restart generations).
+      * LEADER PINNING: plans may pin group 0's leadership onto a
+        named peer before the fault windows open
+        (QuorumNemesisPlan.pin_leader_tick), so directed falsification
+        windows can name fixed peer ids.  The stale-lease witness arm
+        (schedule.py falsification_witness_plan) relies on it:
+        unsafe_witness_lease lets the witness grant a prevote inside
+        the deposed leader's live lease, and the resulting stale lease
+        read MUST be caught by the register invariant — while the
+        honest witness under the SAME schedule must pass.
+
+    Fully deterministic like its bases: digests compared across runs
+    by `make chaos-quorum`.
+    """
+
+    def __init__(self, plan, data_dir: str):
+        from raftsql_tpu.chaos.invariants import DurabilityLedger
+        super().__init__(plan, data_dir)
+        self._witness_set = frozenset(plan.witnesses)
+        # Cross-peer commit view: (group, index) -> payload, fed from
+        # EVERY peer's stream (the base ledger only sees peer 0's).
+        self._xview = DurabilityLedger()
+        # witness_appends survives _crash_restart: bank the dying
+        # node's counter before each reboot.
+        self._wit_banked = 0
+        self._pin_done = False
+        self.report.update({
+            "wal_streams": plan.peers,
+            "apply_streams": plan.peers - len(self._witness_set),
+            "witness_publishes": 0,
+            "pin_transfers": 0,
+        })
+
+    def _note_peer_apply(self, p: int, g: int, idx: int,
+                         payload: bytes) -> None:
+        if p in self._witness_set:
+            self.report["witness_publishes"] += 1
+        self._xview.record(g, idx, payload)
+        super()._note_peer_apply(p, g, idx, payload)
+
+    def _crash_restart(self, tick: int, power_loss: bool = False,
+                       tear_peer: int = -1):
+        if self.node is not None:
+            self._wit_banked += int(self.node.metrics.witness_appends)
+        super()._crash_restart(tick, power_loss=power_loss,
+                               tear_peer=tear_peer)
+
+    def _apply_faults(self, t: int, rng: np.random.Generator) -> None:
+        from raftsql_tpu.runtime.node import TransferRefused
+        plan = self.plan
+        pt = plan.pin_leader_tick
+        if pt >= 0 and pt <= t < pt + 16 and not self._pin_done:
+            tgt = plan.pin_leader_peer
+            lead = self.node.leader_of(0)
+            if lead == tgt:
+                self._pin_done = True
+            elif lead >= 0:
+                try:
+                    self.node.transfer_leadership(0, tgt)
+                    self.report["pin_transfers"] += 1
+                except TransferRefused:
+                    pass         # mid-election / in flight: next tick
+        super()._apply_faults(t, rng)
+
+    def _report(self) -> dict:
+        r = super()._report()
+        wit = self._wit_banked
+        if self.node is not None:
+            wit += int(self.node.metrics.witness_appends)
+        r["witness_appends"] = wit
+        r["cross_peer_slots"] = len(self._xview)
         return r
 
 

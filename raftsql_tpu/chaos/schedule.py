@@ -656,6 +656,173 @@ def falsification_plan(seed: int = 0,
         follower_read_rate=0.4)
 
 
+@dataclasses.dataclass(frozen=True)
+class QuorumNemesisPlan:
+    """Scripted quorum-geometry attack (fused plane, chaos/scenarios.py
+    QuorumChaosRunner): flexible write/election quorums and witness
+    peers under the read-nemesis workload — acked PUTs race lease and
+    ReadIndex reads while partitions, asymmetric cuts, clock skew and
+    crash+restart land.
+
+    A SEPARATE plan class on purpose (same rule as ReadNemesisPlan):
+    extending an existing plan would change the asdict() digest of
+    every committed family.  The runner projects the fault fields into
+    a ChaosSchedule internally and forwards the geometry fields into
+    RaftConfig (write_quorum / election_quorum / witnesses /
+    unsafe_quorum_geometry / unsafe_witness_lease).
+
+    `pin_leader_tick` >= 0 pins group 0's leadership onto
+    `pin_leader_peer` (transfer_leadership, retried for a few ticks)
+    before the fault windows open — the directed falsification plans
+    need to know WHO the partitioned leader is so the windows can be
+    written against fixed peer ids instead of LEADER_TARGET."""
+    seed: int
+    ticks: int
+    peers: int = 3
+    groups: int = 2
+    election_ticks: int = 16
+    lease_ticks: int = 6
+    max_clock_skew: int = 1
+    max_skew_rate: int = 2
+    write_quorum: "int | None" = None
+    election_quorum: "int | None" = None
+    witnesses: Tuple[int, ...] = ()
+    unsafe_geometry: bool = False
+    broken_witness_lease: bool = False
+    pin_leader_tick: int = -1
+    pin_leader_peer: int = 0
+    skews: Tuple[SkewWindow, ...] = ()
+    partitions: Tuple[PartitionWindow, ...] = ()
+    asym_partitions: Tuple[AsymPartitionWindow, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    prop_rate: float = 0.8
+    lease_read_rate: float = 0.8
+    read_index_rate: float = 0.4
+    # Session/follower reads resolve at a RANDOM peer in the read
+    # nemesis; a witness peer has no apply state to answer from, so the
+    # quorum family keeps these modes off by default.
+    session_read_rate: float = 0.0
+    follower_read_rate: float = 0.0
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.describe(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def generate_quorum(seed: int, ticks: int = 240) -> QuorumNemesisPlan:
+    """The quorum-geometry nemesis family: a 2-voter + 1-witness
+    cluster (the last peer is the witness; W = E = 2 explicit, which
+    intersects: W+E > N, 2E > N) sustains acked PUTs plus lease and
+    ReadIndex reads through two skew windows within the configured
+    bound, a leader-targeted full partition, a one-directional leader
+    cut, and a whole-cluster crash+restart — the restart replays the
+    witness's vote/term/log purely from its WAL (it has no shard).
+    Geometry is CORRECT, so every standing invariant must hold and
+    digests must reproduce across runs; the witness must accumulate
+    replicated appends (witness_appends) while its publish stream
+    stays empty (one fewer apply stream than WAL streams)."""
+    rng = np.random.default_rng(seed ^ 0x9E0)
+    warmup = 40
+    rate = 2
+    wit = 2                       # witness slot: the last of 3 peers
+
+    def draw_incs() -> Tuple[int, ...]:
+        incs = [1, 1, 1]
+        fast = int(rng.integers(0, 3))
+        incs[fast] = rate
+        if rng.random() < 0.5:
+            incs[int((fast + 1) % 3)] = 0        # a stalled clock too
+        return tuple(incs)
+
+    s0 = int(rng.integers(warmup, warmup + ticks // 4))
+    w0 = SkewWindow(s0, s0 + int(rng.integers(25, 40)), draw_incs())
+    s1 = int(rng.integers(ticks // 2, int(ticks * 0.75)))
+    w1 = SkewWindow(s1, s1 + int(rng.integers(25, 40)), draw_incs())
+    p0 = int(rng.integers(warmup, ticks // 3))
+    part = PartitionWindow(p0, p0 + int(rng.integers(25, 40)),
+                           LEADER_TARGET)
+    a0 = int(rng.integers(ticks // 3, int(ticks * 0.7)))
+    asym = AsymPartitionWindow(a0, a0 + int(rng.integers(20, 35)),
+                               LEADER_TARGET,
+                               int(rng.integers(0, 2)))  # a voter
+    crash = CrashEvent(int(rng.integers(int(ticks * 0.55),
+                                        int(ticks * 0.85))))
+    return QuorumNemesisPlan(seed=seed, ticks=ticks, peers=3, groups=2,
+                             election_ticks=16, lease_ticks=6,
+                             max_clock_skew=1, max_skew_rate=rate,
+                             write_quorum=2, election_quorum=2,
+                             witnesses=(wit,),
+                             skews=(w0, w1), partitions=(part,),
+                             asym_partitions=(asym,), crashes=(crash,))
+
+
+def falsification_quorum_plan(seed: int = 0,
+                              broken: bool = True) -> QuorumNemesisPlan:
+    """DIRECTED split-brain falsification for flexible quorums: pin
+    group 0's leadership to peer 0, then isolate peer 0 for a long
+    window.  broken=True runs W=1 / E=2 (W + E <= N — the
+    non-intersecting geometry config.py refuses without
+    unsafe_quorum_geometry): the isolated leader keeps solo-committing
+    the acked writes still routed at it while the other two peers
+    elect (E=2 holds without it) and commit DIFFERENT entries into the
+    same slots — two peers surface different payloads for one
+    (group, index) and the harness MUST catch it (the cross-peer
+    durability view's changed-content check, log matching, or commit
+    monotonicity, whichever observes first).  broken=False runs the
+    SAME schedule at W=2: the isolated leader can no longer commit
+    alone and the run must pass — proving the harness is sensitive to
+    exactly the geometry, not to chaos in general."""
+    part = PartitionWindow(60, 170, 0)       # the pinned leader
+    return QuorumNemesisPlan(
+        seed=seed, ticks=220, peers=3, groups=1,
+        election_ticks=10,
+        lease_ticks=0, max_clock_skew=0, max_skew_rate=1,
+        write_quorum=1 if broken else 2, election_quorum=2,
+        unsafe_geometry=broken,
+        pin_leader_tick=30, pin_leader_peer=0,
+        partitions=(part,),
+        prop_rate=1.0, lease_read_rate=0.0, read_index_rate=0.0)
+
+
+def falsification_witness_plan(seed: int = 0,
+                               broken: bool = True) -> QuorumNemesisPlan:
+    """DIRECTED stale-lease falsification for witness accounting: pin
+    group 0's leadership to peer 1 (a full voter; peer 2 is the
+    witness), isolate it at tick 70, and run candidate peer 0's clock
+    at 4x so its election timer fires INSIDE the deposed leader's
+    still-live lease (lease 12 from the last pre-partition quorum ack
+    ~ tick 69; the 16..32-tick timeout draw lands at tick 74..78 of
+    real time).  broken=True sets unsafe_witness_lease: the witness
+    grants the prevote despite sitting inside the leader's lease
+    window, peer 0 wins (E=2 = itself + the witness), commits new
+    acked writes — and the isolated leader, lease in hand, serves a
+    lease read of the OLD value.  The register invariant MUST catch it
+    as a stale lease read.  broken=False runs the SAME schedule with
+    the honest witness: the prevote is refused until the witness's own
+    election timer clears (tick ~86, after the lease died at ~87 — the
+    first honest COMMIT lands later still), so the run must pass —
+    proving a witness counted toward the LEASE quorum is exactly the
+    bug, not chaos in general.  Lease 18 is the directed sweet spot:
+    long enough that the usurper's first committed writes (~tick 80)
+    land while the deposed leader still serves (stale window ~80..84),
+    short enough that the honest election cannot complete inside it."""
+    skew = SkewWindow(60, 130, (4, 1, 1))    # candidate clock at 4x
+    part = PartitionWindow(70, 130, 1)       # isolate the pinned leader
+    return QuorumNemesisPlan(
+        seed=seed, ticks=170, peers=3, groups=1,
+        election_ticks=16, lease_ticks=18,
+        max_clock_skew=0, max_skew_rate=4,
+        witnesses=(2,), write_quorum=2, election_quorum=2,
+        broken_witness_lease=broken,
+        pin_leader_tick=25, pin_leader_peer=1,
+        skews=(skew,), partitions=(part,),
+        prop_rate=1.0, lease_read_rate=1.0, read_index_rate=0.0)
+
+
 def generate_procs(seed: int, ticks: int = 80,
                    peers: int = 3) -> ProcChaosPlan:
     """Derive a process-plane scenario from one seed, with every fault

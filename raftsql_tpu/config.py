@@ -195,6 +195,51 @@ class RaftConfig:
     # kernel, bit for bit.
     unsafe_transfer: bool = False
 
+    # -- Quorum geometry (flexible quorums + witnesses) ----------------
+    #
+    # Howard & Mortier's FPaxos bridge ported into the batched kernels:
+    # the write quorum (AppendEntries acks for commit, lease-clock
+    # confirmation) and the election quorum (prevote/vote tallies) may
+    # be sized independently, as long as every write quorum intersects
+    # every election quorum (W + E > N) — a new leader's election
+    # quorum then always contains at least one peer of every committed
+    # write's quorum, so the log-completeness argument survives.
+    # Unlike FPaxos ballots, raft terms are SHARED across candidates
+    # (one vote per term), so election quorums must also pairwise
+    # intersect (2E > N) or two candidates can win the same term.
+    #
+    # None = majority (N//2 + 1): the default geometry, under which the
+    # compiled step program is bit-identical to a config without these
+    # fields (the chaos digest pin).  Explicit sizes apply to a FULL
+    # voter mask; a reduced mask (mid membership change) falls back to
+    # its own majority — re-validated across joint configs by
+    # membership/manager.py.
+    write_quorum: "int | None" = None
+    election_quorum: "int | None" = None
+
+    # Witness peers (0-based slot ids): vote, grant prevotes, append
+    # and fsync WAL — full quorum citizens for durability and election
+    # math — but never campaign, never lead, own no SQLite shard, and
+    # never serve any read mode.  Cheap durability: a 2-voter+1-witness
+    # group pays two state-machine apply streams, not three.  None/()
+    # keeps the compiled program bit-identical to the default.
+    witnesses: "tuple | None" = None
+
+    # FALSIFICATION ONLY (chaos/run.py quorum family): skip the quorum
+    # intersection validation above, so a deliberately non-intersecting
+    # geometry (W + E <= N) can be compiled and the chaos invariants
+    # (single leader per term, durability ledger) proven to CATCH the
+    # divergence it allows.
+    unsafe_quorum_geometry: bool = False
+
+    # FALSIFICATION ONLY (chaos/run.py quorum family): witness peers
+    # skip the Phase-2b in-lease prevote refusal while their append
+    # acks still count toward the lease clock — the "witness as an
+    # always-available tiebreaker" mistake, which lets an election
+    # complete inside a live lease.  The read-linearizability invariant
+    # must CATCH the stale lease read this opens.
+    unsafe_witness_lease: bool = False
+
     seed: int = 0
 
     def __post_init__(self):
@@ -231,10 +276,73 @@ class RaftConfig:
             # refusal: without it a fast-clocked peer can assemble a
             # quorum inside the lease and serve stale reads.
             raise ValueError("lease_ticks > 0 requires prevote=True")
+        n = self.num_peers
+        for name, q in (("write_quorum", self.write_quorum),
+                        ("election_quorum", self.election_quorum)):
+            if q is not None and not 1 <= q <= n:
+                raise ValueError(f"{name} must be in [1, num_peers]")
+        if not self.unsafe_quorum_geometry:
+            w, e = self.write_size, self.election_size
+            if w + e <= n:
+                # Intersection (FPaxos §3): a new leader's election
+                # quorum must overlap every committed write's quorum.
+                raise ValueError(
+                    f"write_quorum ({w}) + election_quorum ({e}) must "
+                    f"exceed num_peers ({n}) — non-intersecting quorum "
+                    "geometry loses committed writes")
+            if 2 * e <= n:
+                # Raft terms are shared: two election quorums must
+                # intersect or two candidates can win one term.
+                raise ValueError(
+                    f"2 * election_quorum ({e}) must exceed num_peers "
+                    f"({n}) — disjoint election quorums break single "
+                    "leader per term")
+        if self.witnesses is not None:
+            ws = tuple(self.witnesses)
+            if any(not 0 <= w < n for w in ws):
+                raise ValueError("witnesses out of peer-slot range")
+            if len(set(ws)) != len(ws):
+                raise ValueError("witnesses has duplicates")
+            voters = set(self.initial_voters
+                         if self.initial_voters is not None
+                         else range(n))
+            if not set(ws) <= voters:
+                # A witness's whole job is to vote and persist; a
+                # non-voting witness is just a dead slot.
+                raise ValueError("witnesses must be voters")
+            if not voters - set(ws):
+                raise ValueError(
+                    "at least one voter must be a non-witness "
+                    "(someone has to lead and apply)")
 
     @property
     def quorum(self) -> int:
         return self.num_peers // 2 + 1
+
+    @property
+    def write_size(self) -> int:
+        """Write/commit/lease quorum size (explicit, else majority)."""
+        return self.write_quorum if self.write_quorum is not None \
+            else self.quorum
+
+    @property
+    def election_size(self) -> int:
+        """Prevote/vote quorum size (explicit, else majority)."""
+        return self.election_quorum if self.election_quorum is not None \
+            else self.quorum
+
+    @property
+    def default_geometry(self) -> bool:
+        """True when both quorums are plain majorities and no witnesses
+        are configured: the compiled step program must then be
+        bit-identical to one without the geometry fields at all."""
+        return (self.write_quorum is None
+                and self.election_quorum is None
+                and not self.witnesses)
+
+    @property
+    def witness_set(self) -> frozenset:
+        return frozenset(self.witnesses or ())
 
     @property
     def static_full_voters(self) -> bool:

@@ -257,6 +257,21 @@ def initial_voter_row(cfg: RaftConfig):
     return row
 
 
+def witness_row(cfg: RaftConfig):
+    """[P] bool numpy row of cfg's witness slots (all False by default).
+
+    Witness identity is STATIC per config — a compiled constant the
+    step indexes with its traced self_id (cluster.py vmaps self_ids),
+    never device state: witnesses are a deployment shape, not something
+    a log entry changes mid-flight."""
+    import numpy as np
+
+    row = np.zeros((cfg.num_peers,), bool)
+    if cfg.witnesses:
+        row[list(cfg.witnesses)] = True
+    return row
+
+
 @functools.partial(jax.jit, donate_argnums=0)
 def set_group_config(state: PeerState, g: jax.Array,
                      voters_row: jax.Array, joint_row: jax.Array,

@@ -38,7 +38,7 @@ from raftsql_tpu.config import (CANDIDATE, FLOOR_HINT_BIAS, FOLLOWER, LEADER,
                                 MSG_RESP, MSG_TIMEONOW, NO_LEADER, NO_VOTE,
                                 NO_XFER, PRECANDIDATE, RaftConfig)
 from raftsql_tpu.core.state import (I32, Inbox, Outbox, PeerState, StepInfo,
-                                    tbl_floor, term_at_tbl)
+                                    tbl_floor, term_at_tbl, witness_row)
 from raftsql_tpu.ops import dense
 from raftsql_tpu.ops.quorum import (masked_quorum_commit_index,
                                     masked_quorum_match_index,
@@ -119,14 +119,29 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         self_voter = True
 
         def _vote_win(votes):
-            return vote_count(votes) >= cfg.quorum
+            # election_size == cfg.quorum under default geometry; an
+            # explicit election_quorum (config.py flexible quorums)
+            # just substitutes the static threshold constant.
+            return vote_count(votes) >= cfg.election_size
     else:
         voter_src = voters | jvoters                             # [G, P]
         self_voter = jnp.sum(voter_src & self_onehot,
                              axis=-1) > 0                        # [G]
 
         def _vote_win(votes):
-            return masked_vote_win(votes, voters, jvoters)
+            return masked_vote_win(votes, voters, jvoters,
+                                   cfg.election_quorum)
+
+    # Witness self-identity (config.py witnesses): a STATIC [P] bool
+    # constant indexed by the traced self_id — witnesses are a
+    # deployment shape, never device state, so the same compiled
+    # program serves every peer under vmap (core/cluster.py).  The
+    # default (no witnesses) keeps a Python False that folds out of
+    # every gate below, leaving the program bit-identical.
+    if cfg.witnesses:
+        self_witness = jnp.asarray(witness_row(cfg))[self_id]    # scalar
+    else:
+        self_witness = False
 
     log_term, log_len = state.log_term, state.log_len
     tbl_pos, tbl_term = state.tbl_pos, state.tbl_term
@@ -176,6 +191,11 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     tnow_fire = ((inbox.v_type == MSG_TIMEONOW)
                  & (inbox.v_term == term[:, None])).any(-1) \
         & (role != LEADER) & self_voter
+    if cfg.witnesses:
+        # Witnesses never campaign, so they never accept a transfer
+        # grant either (the host refuses witness targets up front —
+        # runtime TransferRefused — this is the device-side backstop).
+        tnow_fire = tnow_fire & ~self_witness
     term = jnp.where(tnow_fire, term + 1, term)
     role = jnp.where(tnow_fire, CANDIDATE, role)
     voted = jnp.where(tnow_fire, self_id, voted)
@@ -212,8 +232,18 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     if cfg.prevote:
         in_lease = (leader_hint != NO_LEADER) & \
             (state.elapsed < cfg.election_ticks)
+        lease_ok = ~in_lease[:, None]
+        if cfg.unsafe_witness_lease and cfg.witnesses:
+            # FALSIFICATION ONLY (config.py unsafe_witness_lease): the
+            # "witness as always-available tiebreaker" mistake — this
+            # witness grants prevotes INSIDE a live lease while its
+            # append acks still feed the lease clock (Phase 8b), so an
+            # election can complete before the lease expires and the
+            # deposed leader serves a stale lease read.  The quorum
+            # chaos family must CATCH it.
+            lease_ok = lease_ok | self_witness
         pre_grant = preq & (inbox.v_term > term[:, None]) & up2date \
-            & voter_src & ~in_lease[:, None]
+            & voter_src & lease_ok
     else:
         pre_grant = jnp.zeros_like(preq)
 
@@ -452,40 +482,46 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # ---- Phase 7: leader commit advance — the quorum reduction kernel
     # (selected by cfg.commit_rule; all implement raft Fig. 2's leader
     # rule, see ops/commit_scan.py and ops/pallas_quorum.py).
+    # All four kernels take the WRITE quorum (config.py flexible
+    # quorums): write_size == cfg.quorum under default geometry, so the
+    # static constants (and the masked kernels' None size) compile the
+    # digest-pinned program unchanged.
     if cfg.commit_rule == "windowed":
         if cfg.static_full_voters:
             from raftsql_tpu.ops.commit_scan import windowed_commit_index
             commit = windowed_commit_index(
                 match, log_term, log_len, commit, term, is_leader,
-                quorum=cfg.quorum, window=W)
+                quorum=cfg.write_size, window=W)
         else:
             from raftsql_tpu.ops.commit_scan import \
                 masked_windowed_commit_index
             commit = masked_windowed_commit_index(
                 match, log_term, log_len, commit, term, is_leader,
-                voters=voters, voters_joint=jvoters, window=W)
+                voters=voters, voters_joint=jvoters, window=W,
+                size=cfg.write_quorum)
     elif cfg.commit_rule == "pallas":
         if cfg.static_full_voters:
             from raftsql_tpu.ops.pallas_quorum import \
                 pallas_quorum_commit_index
             commit = pallas_quorum_commit_index(
                 match, log_term, log_len, commit, term, is_leader,
-                quorum=cfg.quorum, window=W)
+                quorum=cfg.write_size, window=W)
         else:
             from raftsql_tpu.ops.pallas_quorum import \
                 pallas_masked_quorum_commit_index
             commit = pallas_masked_quorum_commit_index(
                 match, log_term, log_len, commit, term, is_leader,
-                voters=voters, voters_joint=jvoters, window=W)
+                voters=voters, voters_joint=jvoters, window=W,
+                size=cfg.write_quorum)
     elif cfg.static_full_voters:
         commit = quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
-            quorum=cfg.quorum, window=W, term_of=term_of1)
+            quorum=cfg.write_size, window=W, term_of=term_of1)
     else:
         commit = masked_quorum_commit_index(
             match, log_term, log_len, commit, term, is_leader,
             voters=voters, voters_joint=jvoters, window=W,
-            term_of=term_of1)
+            term_of=term_of1, size=cfg.write_quorum)
 
     # ---- Phase 8: timers and election start.  tnow_fire counts as a
     # reset: the transfer target just started a REAL election (Phase 1b)
@@ -497,6 +533,11 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
     # timers tick but cannot fire — they follow whoever the voters
     # elect and wait for a conf entry to promote them.
     fire = (role != LEADER) & (elapsed >= state.timeout) & self_voter
+    if cfg.witnesses:
+        # Witnesses vote and persist but never campaign or lead: their
+        # election timers tick (they still grant, and their timer state
+        # feeds the lease exclusion window) but cannot fire.
+        fire = fire & ~self_witness
     term_resp = term          # term used in responses composed above
     if cfg.prevote:
         # Timeout starts a PROBE, not an election: role flips to
@@ -562,14 +603,17 @@ def peer_step(cfg: RaftConfig, state: PeerState, inbox: Inbox,
         jnp.where(self_onehot, tick_now, resp_tick), 0)
     if cfg.lease_ticks > 0:
         if cfg.static_full_voters:
-            q_tick = quorum_match_index(resp_tick, cfg.quorum)
+            # The lease clock is WRITE-quorum evidence (append acks).
+            q_tick = quorum_match_index(resp_tick, cfg.write_size)
         else:
             # Joint consensus: the lease needs a quorum of BOTH masks
             # (a read served on the old majority alone could miss a
             # leader elected by the new one, and vice versa).
             q_tick = jnp.minimum(
-                masked_quorum_match_index(resp_tick, voters),
-                masked_quorum_match_index(resp_tick, jvoters))
+                masked_quorum_match_index(resp_tick, voters,
+                                          cfg.write_quorum),
+                masked_quorum_match_index(resp_tick, jvoters,
+                                          cfg.write_quorum))
         # §6.4 precondition, folded in on device: the lease read's
         # target is the leader's commit index, which is only current
         # once an entry of its own term has committed.

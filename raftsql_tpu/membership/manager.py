@@ -105,12 +105,25 @@ class GroupConfig:
 
 class MembershipManager:
     def __init__(self, num_peers: int, num_groups: int,
-                 initial_voters: Optional[Tuple[int, ...]] = None):
+                 initial_voters: Optional[Tuple[int, ...]] = None,
+                 write_quorum: Optional[int] = None,
+                 election_quorum: Optional[int] = None,
+                 witnesses: Tuple[int, ...] = (),
+                 unsafe_geometry: bool = False):
         if num_peers > 64:
             raise MembershipError(
                 "membership masks are u64 slot bitmasks: P <= 64")
         self.P = num_peers
         self.G = num_groups
+        # Quorum geometry (config.py flexible quorums): explicit sizes
+        # apply only to FULL masks (ops/quorum.py mask_threshold
+        # contract); reduced masks fall back to their own majority.
+        self.write_quorum = write_quorum
+        self.election_quorum = election_quorum
+        self.witness_mask = 0
+        for w in witnesses:
+            self.witness_mask |= 1 << w
+        self.unsafe_geometry = unsafe_geometry
         full = (1 << num_peers) - 1
         if initial_voters is not None:
             full = 0
@@ -197,7 +210,7 @@ class MembershipManager:
             n = popcount(mask)
             got = sum(1 for i in range(self.P)
                       if mask >> i & 1 and conf[i])
-            return got >= n // 2 + 1
+            return got >= self._write_need(mask)
         return maj(c.voters) and maj(c.joint)
 
     def quorum_nth(self, group: int, vals: np.ndarray) -> int:
@@ -216,8 +229,46 @@ class MembershipManager:
                           if mask >> i & 1), reverse=True)
             if not got:
                 return -(1 << 40)    # all-learner: no quorum, no lease
-            return got[popcount(mask) // 2]
+            return got[self._write_need(mask) - 1]
         return min(nth(c.voters), nth(c.joint))
+
+    def _write_need(self, mask: int) -> int:
+        """Write-quorum threshold for a voter mask: the explicit
+        flexible size on a FULL mask, the mask's own majority otherwise
+        (mask_threshold contract — an explicit size was validated
+        against all P slots and carries no intersection guarantee over
+        a subset)."""
+        n = popcount(mask)
+        if self.write_quorum is not None and n == self.P:
+            return self.write_quorum
+        return n // 2 + 1
+
+    def _check_geometry(self, new_voters: int, old_voters: int) -> None:
+        """Re-validate quorum geometry across both joint halves before
+        a config change flies (config.py validated the boot geometry
+        against all P slots; a change must not re-open the hole).  Each
+        half's effective thresholds follow the full-mask contract, so
+        the intersection invariants W+E > n and 2E > n must hold per
+        half — and a half whose voters are all witnesses could never
+        elect a leader or apply a command, so at least one non-witness
+        voter must survive in both."""
+        if not self.unsafe_geometry:
+            for mask in (new_voters, old_voters):
+                n = popcount(mask)
+                full = n == self.P
+                w = self.write_quorum if (
+                    full and self.write_quorum is not None) else n // 2 + 1
+                e = self.election_quorum if (
+                    full and self.election_quorum is not None) else n // 2 + 1
+                if w + e <= n or 2 * e <= n:
+                    raise MembershipError(
+                        f"change would yield non-intersecting quorum "
+                        f"geometry (W={w}, E={e}, n={n})")
+        for mask in (new_voters, old_voters):
+            if mask and not mask & ~self.witness_mask:
+                raise MembershipError(
+                    "change would leave only witness voters (someone "
+                    "has to lead and apply)")
 
     # -- building changes (admin plane) ---------------------------------
 
@@ -260,6 +311,7 @@ class MembershipManager:
                 if not c.learners & bit:
                     raise MembershipError(
                         f"peer {peer} is not a learner (add it first)")
+                self._check_geometry(c.voters | bit, c.voters)
                 entry = encode_conf_entry(
                     CONF_KIND_ENTER_JOINT, c.voters | bit, c.voters,
                     c.learners & ~bit)
@@ -269,6 +321,7 @@ class MembershipManager:
                 if popcount(c.voters & ~bit) == 0:
                     raise MembershipError(
                         "refusing to remove the last voter")
+                self._check_geometry(c.voters & ~bit, c.voters)
                 entry = encode_conf_entry(
                     CONF_KIND_ENTER_JOINT, c.voters & ~bit, c.voters,
                     c.learners)

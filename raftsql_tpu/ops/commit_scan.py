@@ -50,16 +50,18 @@ def masked_windowed_commit_index(match: jax.Array, log_term: jax.Array,
                                  term: jax.Array, is_leader: jax.Array,
                                  *, voters: jax.Array,
                                  voters_joint: jax.Array,
-                                 window: int) -> jax.Array:
+                                 window: int, size=None) -> jax.Array:
     """The windowed rule under a per-group voter configuration
     (ops/quorum.py mask-weighted quorum): the scan's ceiling is the min
     of the two masks' quorum indexes (joint consensus), so every group
     can sit in a different configuration inside the one fused kernel.
-    Full masks reproduce `windowed_commit_index` bit for bit."""
+    Full masks reproduce `windowed_commit_index` bit for bit; `size`
+    applies the flexible write-quorum threshold on full masks."""
     from raftsql_tpu.ops.quorum import masked_quorum_match_index
 
-    qmatch = jnp.minimum(masked_quorum_match_index(match, voters),
-                         masked_quorum_match_index(match, voters_joint))
+    qmatch = jnp.minimum(
+        masked_quorum_match_index(match, voters, size),
+        masked_quorum_match_index(match, voters_joint, size))
     return _windowed_from_qmatch(qmatch, log_term, log_len, commit,
                                  term, is_leader)
 

@@ -114,7 +114,7 @@ def pallas_quorum_commit_index(match: jax.Array, log_term: jax.Array,
 _NEG = -(1 << 30)
 
 
-def _masked_kernel(window: int,
+def _masked_kernel(window: int, size,
                    match_ref, vot_ref, jvot_ref, log_term_ref,
                    log_len_ref, commit_ref, term_ref, leader_ref,
                    out_ref):
@@ -133,6 +133,10 @@ def _masked_kernel(window: int,
         mi32 = mask.astype(I32)
         nv = jnp.sum(mi32, axis=-1, keepdims=True)      # [Gb, 1]
         need = nv // 2 + 1
+        if size is not None:
+            # Flexible write quorum on FULL masks only (mask_threshold
+            # contract, ops/quorum.py): reduced masks keep majority.
+            need = jnp.where(nv == P, I32(size), need)
         cand = jnp.full_like(commit, _NEG)
         for i in range(P):
             mi = m[:, i:i + 1]
@@ -161,7 +165,7 @@ def pallas_masked_quorum_commit_index(
         match: jax.Array, log_term: jax.Array, log_len: jax.Array,
         commit: jax.Array, term: jax.Array, is_leader: jax.Array,
         *, voters: jax.Array, voters_joint: jax.Array, window: int,
-        block_g: int = 1024,
+        size=None, block_g: int = 1024,
         interpret: bool | None = None) -> jax.Array:
     """Mask-weighted drop-in for `ops.quorum.masked_quorum_commit_index`."""
     G, P = match.shape
@@ -179,7 +183,7 @@ def pallas_masked_quorum_commit_index(
 
     widths = (P, P, P, window, 1, 1, 1, 1)
     out = pl.pallas_call(
-        functools.partial(_masked_kernel, window),
+        functools.partial(_masked_kernel, window, size),
         grid=(gp // gb,),
         in_specs=[pl.BlockSpec((gb, w), lambda i: (i, 0)) for w in widths],
         out_specs=pl.BlockSpec((gb, 1), lambda i: (i, 0)),

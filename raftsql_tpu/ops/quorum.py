@@ -52,36 +52,59 @@ def mask_majority(mask: jax.Array) -> jax.Array:
     return mask.sum(-1).astype(I32) // 2 + 1
 
 
+def mask_threshold(mask: jax.Array, size=None) -> jax.Array:
+    """Per-group quorum threshold for a voter mask: the mask majority,
+    or the explicit flexible-quorum `size` where the mask is FULL.
+
+    A reduced mask (mid membership change, or a seeded partial voter
+    set) falls back to its own majority: an explicit size was validated
+    against N provisioned slots and carries no intersection guarantee
+    over an arbitrary subset — membership/manager.py re-validates the
+    geometry across joint halves before letting a change fly.  size
+    None compiles to exactly `mask_majority` (the digest-pinned path).
+    """
+    maj = mask_majority(mask)
+    if size is None:
+        return maj
+    P = mask.shape[-1]
+    full = mask.sum(-1).astype(I32) == P
+    return jnp.where(full, I32(size), maj)
+
+
 def masked_vote_count(votes: jax.Array, mask: jax.Array) -> jax.Array:
     """[G, P] bool votes -> [G] granted votes FROM VOTERS only."""
     return jnp.sum(votes & mask, axis=-1).astype(I32)
 
 
 def masked_vote_win(votes: jax.Array, voters: jax.Array,
-                    voters_joint: jax.Array) -> jax.Array:
+                    voters_joint: jax.Array, size=None) -> jax.Array:
     """[G] bool: the vote set wins under the active configuration.
 
     Joint consensus (raft §6 / the thesis' C_old,new): a candidate needs
     a majority of BOTH masks.  In the stable state voters_joint ==
     voters and the double check degenerates to the single majority.
+    `size` is the flexible election-quorum threshold applied to full
+    masks (mask_threshold); None keeps the majority kernel bit for bit.
     """
-    return (masked_vote_count(votes, voters) >= mask_majority(voters)) \
+    return (masked_vote_count(votes, voters)
+            >= mask_threshold(voters, size)) \
         & (masked_vote_count(votes, voters_joint)
-           >= mask_majority(voters_joint))
+           >= mask_threshold(voters_joint, size))
 
 
-def masked_quorum_match_index(match: jax.Array,
-                              voters: jax.Array) -> jax.Array:
+def masked_quorum_match_index(match: jax.Array, voters: jax.Array,
+                              size=None) -> jax.Array:
     """[G, P] match + [G, P] bool voter mask -> [G] mask-weighted
     quorum index: the largest index replicated on a majority of the
     group's voters.  Non-voters contribute NON_VOTER to the sort; the
     per-group majority selects a (data-dependent) sorted position via a
     one-hot reduce — no gather.  With a full mask this is exactly
-    `quorum_match_index(match, P // 2 + 1)`."""
+    `quorum_match_index(match, P // 2 + 1)`; `size` substitutes the
+    flexible write-quorum threshold on full masks (mask_threshold)."""
     P = match.shape[-1]
     m = jnp.where(voters, match, NON_VOTER)
     s = jnp.sort(m, axis=-1)                         # ascending
-    need = mask_majority(voters)                     # [G]
+    need = mask_threshold(voters, size)              # [G]
     lanes = jnp.arange(P, dtype=I32)
     sel = lanes == (P - need)[..., None]             # [G, P] one-hot
     got = jnp.sum(jnp.where(sel, s, 0), axis=-1)
@@ -94,16 +117,18 @@ def masked_quorum_commit_index(match: jax.Array, log_term: jax.Array,
                                term: jax.Array, is_leader: jax.Array,
                                *, voters: jax.Array,
                                voters_joint: jax.Array, window: int,
-                               term_of=None) -> jax.Array:
+                               term_of=None, size=None) -> jax.Array:
     """`quorum_commit_index` under the active per-group configuration:
     the commit candidate must be replicated on a majority of BOTH masks
     (joint consensus), i.e. the min of the two mask-weighted quorum
     indexes.  Stable groups (joint == voters) reduce to the single-mask
-    rule, and a full mask reproduces the static kernel bit for bit."""
+    rule, and a full mask reproduces the static kernel bit for bit —
+    or, with `size`, applies the flexible write-quorum threshold."""
     from raftsql_tpu.core.state import term_at
 
-    cand = jnp.minimum(masked_quorum_match_index(match, voters),
-                       masked_quorum_match_index(match, voters_joint))
+    cand = jnp.minimum(
+        masked_quorum_match_index(match, voters, size),
+        masked_quorum_match_index(match, voters_joint, size))
     if term_of is None:
         cand_term = term_at(log_term, log_len, cand, window)
     else:

@@ -158,7 +158,18 @@ class PlacementController:
                 if leaders[g] >= 0:
                     loads[leaders[g]] += rates[g]
             hot_p = int(np.argmax(loads))
-            cold_p = int(np.argmin(loads))
+            sel = loads
+            wit = getattr(node.cfg, "witness_set", frozenset())
+            if wit:
+                # A witness never leads, so it is always the idlest
+                # slot — and never a legal transfer destination
+                # (transfer_leadership would refuse it anyway; don't
+                # even nominate it, or every pass burns a refusal).
+                sel = loads.copy()
+                sel[sorted(wit)] = np.inf
+            cold_p = int(np.argmin(sel))
+            if not np.isfinite(sel[cold_p]):
+                continue            # every non-hot slot is a witness
             gap = loads[hot_p] - loads[cold_p]
             pass_gap = max(pass_gap, float(gap))
             if loads[hot_p] < self.min_rate \

@@ -63,9 +63,13 @@ class ReshardCoordinator:
                  num_groups: Optional[int] = None,
                  broken_flip: bool = False,
                  retry_steps: int = RETRY_STEPS,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 witness_peers: tuple = ()):
         self.backend = backend
         self.keymap = keymap
+        # Witness peers (config.py quorum geometry) own no shard, so a
+        # migrate verb must never pick one as its destination.
+        self.witness_peers = frozenset(witness_peers)
         self.num_groups = int(num_groups) if num_groups is not None \
             else len(set(keymap.slots) | keymap.retired)
         # Falsification hook: flip the router WITHOUT waiting for the
@@ -109,6 +113,10 @@ class ReshardCoordinator:
                 if set(slots) == owned and dst != src:
                     verb = "merge"   # moving everything IS a merge
             else:                    # migrate: dst is a target peer
+                if dst in self.witness_peers:
+                    raise ReshardRefused(
+                        f"peer {dst} is a witness (owns no shard); "
+                        "not a migration destination")
                 slots = []
             if verb != "migrate" and src == dst:
                 raise ReshardRefused("src and dst are the same group")

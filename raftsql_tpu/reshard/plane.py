@@ -107,9 +107,12 @@ class ReshardPlane:
         self.ship_dir = ship_dir or os.path.join(
             getattr(db, "data_dir", "."), "reshard-ship")
         self.keymap = KeyMap.initial(db.num_groups, nslots)
-        self.coord = ReshardCoordinator(self, self.keymap,
-                                        num_groups=db.num_groups,
-                                        clock=time.monotonic)
+        wit = getattr(getattr(db.pipe, "node", None), "cfg", None)
+        self.coord = ReshardCoordinator(
+            self, self.keymap, num_groups=db.num_groups,
+            clock=time.monotonic,
+            witness_peers=tuple(wit.witness_set) if wit is not None
+            else ())
         self._ddl_done: set = set()      # groups with the journal table
         self._kv_ddl_done: set = set()   # groups with the kv table
         # Per-slot PUT counters feeding split-hottest's partition

@@ -223,6 +223,17 @@ class RaftDB:
         self._compact_every = compact_every if resume else 0
         self._compact_keep = compact_keep
         self._applies_since_compact = 0
+        # Witness replica (config.py quorum geometry): this node votes,
+        # appends and fsyncs — but owns no SQLite shard.  The real
+        # sm_factory is never invoked, so no shard file or directory is
+        # ever created; committed payloads are discarded at apply time
+        # (they are already durable in the WAL, which is all a witness
+        # owes the cluster) and every read is refused up front.
+        self.witness_self = bool(getattr(pipe.node, "witness_self",
+                                         False))
+        if self.witness_self:
+            from raftsql_tpu.models.witness import WitnessStateMachine
+            sm_factory = WitnessStateMachine
         self._sms: Dict[int, StateMachine] = {
             g: sm_factory(g) for g in range(num_groups)}
         if not any(getattr(sm, "has_durable_snapshot", False)
@@ -598,6 +609,12 @@ class RaftDB:
         NotLeaderError on the next poll, never an unbounded spin."""
         if not is_select(query):
             raise ValueError("expected SELECT")
+        if self.witness_self:
+            # Refuse up front: a witness applies nothing, so any wait
+            # on its applied index would just spin to ReadTimeout.
+            raise ValueError(
+                "witness replica serves no reads (it owns no shard); "
+                "route the query to a full voter")
         if not 0 <= group < self.num_groups:
             raise ValueError(f"group {group} out of range "
                              f"[0, {self.num_groups})")
@@ -739,6 +756,16 @@ class RaftDB:
             v, l = node.cfg.num_peers * node.cfg.num_groups, 0
         m["members_voters"] = v
         m["members_learners"] = l
+        # Quorum geometry (config.py flexible quorums + witnesses):
+        # the per-phase thresholds this deployment runs under and the
+        # provisioned witness count — static per config, exported so an
+        # operator can read the geometry off any node's /metrics.
+        cfg = node.cfg
+        m["quorum"] = {
+            "write_size": cfg.write_size,
+            "election_size": cfg.election_size,
+            "witnesses": len(cfg.witness_set),
+        }
         # Telemetry plane (PR 8, default on): per-phase tick wall-time
         # histograms and the per-group traffic table with its top-K
         # hot-groups rows — the feed the placement controller consumes.
@@ -860,6 +887,11 @@ class RaftDB:
                         max(lease_fn(g) - now, 0.0), 4)
         doc = {"id": int(getattr(node, "node_id", 0)),
                "ready": True, "groups": groups}
+        if self.witness_self:
+            # Routers and the chaos harness key off this: witnesses
+            # accept writes (forwarded like any follower) but must
+            # never be picked as a read target.
+            doc["witness"] = True
         # Elastic keyspace (raftsql_tpu/reshard/): the versioned
         # key->group mapping.  Clients cache this and fail closed when
         # a /kv response reports a newer epoch.

@@ -238,6 +238,12 @@ class ClusterHostPlane:
         # --fused server and the durable bench drain peer 0) set {0}
         # and skip 2/3 of the publish slicing + queue traffic.
         self.publish_peers: Optional[set] = None
+        # Witness peers (config.py quorum geometry): they vote, append
+        # and fsync — full quorum citizens on the durability plane —
+        # but own no state machine: their commit streams are never
+        # materialized (cursor-advance only in _publish_shard) and
+        # placement/transfer refuse them as leadership targets.
+        self.witness_peers: frozenset = cfg.witness_set
         # Native KV apply plane (models/kv_native.py): when set AND the
         # payload plane is native, peer 0's committed ranges are applied
         # inside one C call per publish instead of being materialized as
@@ -507,6 +513,7 @@ class ClusterHostPlane:
         log_terms: Dict[int, list] = {}
         hard: Dict[int, tuple] = {}
         starts: Dict[int, tuple] = {}
+        g_peer_publishes = p not in self.cfg.witness_set
         for g, gl in logs.items():
             log_terms[g] = [t for (t, _) in gl.entries]
             hard[g] = (gl.hard.term, gl.hard.vote, gl.hard.commit)
@@ -520,7 +527,10 @@ class ClusterHostPlane:
             self._applied[p, g] = commit
             datas = plog.try_slice(g, gl.start + 1,
                                    max(commit - gl.start, 0))
-            if datas:
+            # A witness replays its WAL for votes/terms/log only — it
+            # has no apply plane, so nothing is re-published (the live
+            # path in _publish_shard advances its cursor the same way).
+            if datas and g_peer_publishes:
                 self._commit_qs[p].put((RAW_PLAIN, g, gl.start, datas))
         return restore_peer_state(self.cfg, p, log_terms, hard, seed,
                                   starts=starts or None)
@@ -570,13 +580,17 @@ class ClusterHostPlane:
         P, G = self.cfg.num_peers, self.cfg.num_groups
         iv = initial_voters if initial_voters is not None \
             else self.cfg.initial_voters
-        mm = MembershipManager(P, G, initial_voters=iv)
+        geo = dict(write_quorum=self.cfg.write_quorum,
+                   election_quorum=self.cfg.election_quorum,
+                   witnesses=self.cfg.witnesses or (),
+                   unsafe_geometry=self.cfg.unsafe_quorum_geometry)
+        mm = MembershipManager(P, G, initial_voters=iv, **geo)
         self._conf_pending = [[] for _ in range(G)]
         self._conf_scrub = [set() for _ in range(G)]
         self._conf_cursor = np.zeros((P, G), np.int64)
         pend: List[Dict[int, bytes]] = [dict() for _ in range(G)]
         for p in range(P):
-            view = MembershipManager(P, G, initial_voters=iv)
+            view = MembershipManager(P, G, initial_voters=iv, **geo)
             for g in range(G):
                 base = self._replayed_conf[p].get(g)
                 plog = self.plogs[p]
@@ -681,7 +695,7 @@ class ClusterHostPlane:
             d["leader"] = self.leader_of(g) + 1
             out[str(g)] = d
         return {"num_peers": self.cfg.num_peers, "groups": out,
-                "node": 0}
+                "witnesses": sorted(self.witness_peers), "node": 0}
 
     def member_change(self, group: int, op: str, peer: int) -> dict:
         """Admin plane for the co-located cluster: every peer lives in
@@ -734,6 +748,12 @@ class ClusterHostPlane:
             self.metrics.transfers_refused += 1
             raise TransferRefused(
                 group, f"peer {target} is a learner/non-voter")
+        if target in self.witness_peers:
+            # A witness never campaigns or applies (core/step.py Phase
+            # 8 gate): arming the latch would stall the group until the
+            # transfer deadline aborts it.
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(group, f"peer {target} is a witness")
         dl = int(deadline_ticks) if deadline_ticks \
             else 4 * cfg.election_ticks
         with self._xfer_lock:
@@ -1559,6 +1579,13 @@ class ClusterHostPlane:
                 if c:
                     self.tracer.note_replicate(g, st + c - 1)
 
+        if self.witness_peers and m_peer:
+            # Witnesses never lead, so every entry they persist arrives
+            # here as a mirrored follower append.
+            self.metrics.witness_appends += sum(
+                c for p, c in zip(m_peer, m_count)
+                if c and p in self.witness_peers)
+
         # Phase 2a: leader appends (fresh-leader no-ops + accepted
         # proposals) as uniform-term RANGES per peer — the write plan
         # was staged (and the payloads popped) by _stage_ranges; one
@@ -1781,10 +1808,12 @@ class ClusterHostPlane:
                 # Quorum/commit stamp on the client-facing stream.
                 for g, c in zip(ready.tolist(), commit[ready].tolist()):
                     self.tracer.note_commit(g, int(c))
-            if self.publish_peers is not None \
-                    and p not in self.publish_peers:
-                # Nobody consumes this peer's stream: advance the
-                # cursor without materializing anything.
+            if (self.publish_peers is not None
+                    and p not in self.publish_peers) \
+                    or p in self.witness_peers:
+                # Nobody consumes this peer's stream (or it is a
+                # witness, which never applies): advance the cursor
+                # without materializing anything.
                 if p == 0:
                     deltas = commit[ready] - self._applied[p][ready]
                     self.traffic.add_commit(ready, deltas)

@@ -167,6 +167,11 @@ class RaftNode:
         self.node_id = node_id
         self.self_id = node_id - 1
         self.num_nodes = num_nodes
+        # Witness identity (config.py quorum geometry): a witness votes,
+        # appends and fsyncs but owns no shard — runtime/db.py reads
+        # this flag and installs the discard-only WitnessStateMachine
+        # instead of ever invoking the SQLite factory.
+        self.witness_self = self.self_id in cfg.witness_set
         self.data_dir = data_dir
         self.transport = transport
 
@@ -372,7 +377,11 @@ class RaftNode:
         # REC_CONF baseline, then conf ENTRIES committed above it, then
         # appended-but-uncommitted ones back into the pending list.
         self.membership = MembershipManager(
-            num_nodes, G, initial_voters=cfg.initial_voters) \
+            num_nodes, G, initial_voters=cfg.initial_voters,
+            write_quorum=cfg.write_quorum,
+            election_quorum=cfg.election_quorum,
+            witnesses=cfg.witnesses or (),
+            unsafe_geometry=cfg.unsafe_quorum_geometry) \
             if num_nodes <= 64 else None
         if self.membership is not None:
             mm = self.membership
@@ -616,6 +625,7 @@ class RaftNode:
             d["leader"] = self.leader_of(g) + 1      # 1-based, 0 unknown
             out[str(g)] = d
         return {"num_peers": self.num_nodes, "groups": out,
+                "witnesses": sorted(self.cfg.witness_set),
                 "node": self.node_id}
 
     def _membership_tick(self, info) -> None:
@@ -662,6 +672,13 @@ class RaftNode:
             self.metrics.transfers_refused += 1
             raise TransferRefused(
                 group, f"peer {target} is a learner/non-voter")
+        if target in cfg.witness_set:
+            # Witnesses vote and persist but never lead (config.py
+            # quorum geometry): handing one the lease would strand the
+            # group — the device gate (core/step.py Phase 1b) would eat
+            # the TimeoutNow and the transfer would stall to deadline.
+            self.metrics.transfers_refused += 1
+            raise TransferRefused(group, f"peer {target} is a witness")
         dl = int(deadline_ticks) if deadline_ticks \
             else 4 * cfg.election_ticks
         with self._xfer_lock:
@@ -829,7 +846,10 @@ class RaftNode:
         if mm is not None and not mm.is_default(group):
             q = mm.quorum_nth(group, clocks)
         else:
-            q = int(np.sort(clocks)[self.num_nodes - cfg.quorum])
+            # Lease evidence is WRITE-quorum evidence (append acks):
+            # under flexible geometry the election quorum intersects
+            # every write quorum, so write_size acks fence elections.
+            q = int(np.sort(clocks)[self.num_nodes - cfg.write_size])
         return commit, (q + cfg.lease_ticks) - (now + cfg.max_clock_skew)
 
     def lease_read(self, group: int) -> Optional[int]:
@@ -927,7 +947,10 @@ class RaftNode:
         if mm is not None and not mm.is_default(group):
             # Mask-weighted confirmation (joint: both majorities).
             return mm.quorum_confirmed(group, ok, self.self_id)
-        return int(ok.sum()) + 1 >= self.cfg.quorum
+        # ReadIndex confirmation is write-quorum sized: any election
+        # quorum intersects it, so a confirmed round proves no newer
+        # leader committed past the registration snapshot.
+        return int(ok.sum()) + 1 >= self.cfg.write_size
 
     # ------------------------------------------------------------------
     # batched ReadIndex (PR 12): all linearizable reads registered
@@ -1662,6 +1685,8 @@ class RaftNode:
                         start, rec.ent_terms[:n_app]):
                     put_run(g, rs, rc, rt)
                 w_data.extend(rec.payloads[:n_app])
+                if self.witness_self and n_app:
+                    self.metrics.witness_appends += n_app
                 self.payload_log.put(g, start, rec.payloads,
                                      rec.ent_terms, new_len=new_len)
                 if mm is not None:

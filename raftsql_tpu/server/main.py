@@ -54,7 +54,10 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
                compact_every: int = 0, compact_keep: int = 1024,
                wal_segment_bytes: int = 4 << 20,
                trace: bool = False, lease_ticks: int = 0,
-               max_clock_skew: int = 1) -> RaftDB:
+               max_clock_skew: int = 1,
+               write_quorum: int | None = None,
+               election_quorum: int | None = None,
+               witnesses: tuple = ()) -> RaftDB:
     peers = cluster.split(",")
     # Default election/heartbeat timing is REAL-TIME parity with the
     # reference (~1 s election timeout, ~100 ms heartbeat at its 100 ms
@@ -86,7 +89,10 @@ def build_node(cluster: str, node_id: int, groups: int = 1,
                      heartbeat_ticks=heartbeat_ticks,
                      wal_segment_bytes=wal_segment_bytes,
                      lease_ticks=lease_ticks,
-                     max_clock_skew=max_clock_skew)
+                     max_clock_skew=max_clock_skew,
+                     write_quorum=write_quorum,
+                     election_quorum=election_quorum,
+                     witnesses=tuple(witnesses) or None)
     transport = TcpTransport(peers, node_id - 1)
     pipe = RaftPipe.create(node_id, len(peers), cfg, transport,
                            data_dir=f"{data_prefix}-{node_id}")
@@ -111,7 +117,10 @@ def build_fused_node(groups: int = 1, peers: int = 3,
                      trace: bool = False,
                      wal_group_commit: bool = True,
                      lease_ticks: int = 0,
-                     max_clock_skew: int = 1) -> RaftDB:
+                     max_clock_skew: int = 1,
+                     write_quorum: int | None = None,
+                     election_quorum: int | None = None,
+                     witnesses: tuple = ()) -> RaftDB:
     """The --fused single-process deployment: all P peers of every
     group co-located in THIS process, consensus advanced by ONE fused
     device program per tick (runtime/fused.py), per-peer WALs on disk,
@@ -129,11 +138,20 @@ def build_fused_node(groups: int = 1, peers: int = 3,
             "election_ticks"].default
         lease_ticks = min(lease_ticks,
                           max(1, election_default - max_clock_skew - 1))
+    if 0 in tuple(witnesses):
+        # The fused deployment applies SQLite from peer 0's publish
+        # stream (FusedPipe publish_peers={0}); a witness publishes
+        # nothing, so slot 0 as witness would serve an empty database.
+        raise ValueError("--fused applies from peer slot 0; pick a "
+                         "different --witness slot")
     cfg = RaftConfig(num_groups=groups, num_peers=peers,
                      tick_interval_s=tick,
                      wal_segment_bytes=wal_segment_bytes,
                      lease_ticks=lease_ticks,
-                     max_clock_skew=max_clock_skew)
+                     max_clock_skew=max_clock_skew,
+                     write_quorum=write_quorum,
+                     election_quorum=election_quorum,
+                     witnesses=tuple(witnesses) or None)
     # WAL group commit is the serving default: one write+fsync per tick
     # for all P peers (storage/wal.py GroupCommitWAL).  An existing
     # per-peer data dir keeps its layout (the host plane refuses to
@@ -329,6 +347,20 @@ def main(argv=None) -> None:
                          "ReadIndex quorum round.  Clamped below the "
                          "election timeout; requires bounded relative "
                          "clock rates (config.py lease_ticks)")
+    ap.add_argument("--write-quorum", type=int, default=None,
+                    help="flexible quorum geometry (config.py): size "
+                         "of the append/commit/lease quorum; default "
+                         "majority.  W + E must exceed the peer count")
+    ap.add_argument("--election-quorum", type=int, default=None,
+                    help="size of the vote/prevote quorum; default "
+                         "majority (2E must also exceed the peer "
+                         "count)")
+    ap.add_argument("--witness", type=int, action="append", default=[],
+                    metavar="SLOT",
+                    help="0-based peer slot to run as a WITNESS: "
+                         "votes, appends and fsyncs its WAL but owns "
+                         "no SQLite shard and serves no reads "
+                         "(repeatable)")
     ap.add_argument("--max-clock-skew", type=int, default=1,
                     help="clock-skew slack (ticks) subtracted from "
                          "every lease validity check")
@@ -392,6 +424,14 @@ def main(argv=None) -> None:
     # the serving process, the complement of the JAX profiler's device
     # traces in bench.py).
     if args.mesh:
+        if (args.write_quorum is not None
+                or args.election_quorum is not None or args.witness):
+            # The mesh runtime shards the GROUP axis; its geometry
+            # plumbing is untested — refuse loudly rather than boot a
+            # cluster whose quorums silently differ from the flags.
+            ap.error("--write-quorum/--election-quorum/--witness are "
+                     "not supported with --mesh (use --fused or the "
+                     "multi-process deployment)")
         rdb = build_mesh_node(groups=args.groups, peers=args.peers,
                               tick=args.tick,
                               group_shards=args.group_shards,
@@ -411,7 +451,10 @@ def main(argv=None) -> None:
                                wal_group_commit=args.wal_group_commit
                                == "on",
                                lease_ticks=args.lease_ticks,
-                               max_clock_skew=args.max_clock_skew)
+                               max_clock_skew=args.max_clock_skew,
+                               write_quorum=args.write_quorum,
+                               election_quorum=args.election_quorum,
+                               witnesses=tuple(args.witness))
     else:
         rdb = build_node(args.cluster, args.id, groups=args.groups,
                          tick=args.tick, resume=args.resume,
@@ -420,7 +463,10 @@ def main(argv=None) -> None:
                          wal_segment_bytes=args.wal_segment_bytes,
                          trace=args.trace,
                          lease_ticks=args.lease_ticks,
-                         max_clock_skew=args.max_clock_skew)
+                         max_clock_skew=args.max_clock_skew,
+                         write_quorum=args.write_quorum,
+                         election_quorum=args.election_quorum,
+                         witnesses=tuple(args.witness))
     _watch_fatal(rdb)
     if args.placement:
         if not (args.fused or args.mesh):

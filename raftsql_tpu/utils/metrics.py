@@ -33,6 +33,12 @@ class NodeMetrics:
     # members_learners are computed live from the manager at export
     # time (runtime/db.py metrics()).
     conf_changes_applied: int = 0
+    # Quorum geometry (config.py flexible quorums + witness peers):
+    # entries fsynced into witness peers' WALs — durability contributed
+    # by voters that own no SQLite shard.  The companion gauges
+    # quorum.{write_size,election_size,witnesses} are computed from the
+    # config at export time (runtime/db.py metrics()).
+    witness_appends: int = 0
     # Serving-plane 10x counters (PR 7): WAL group commits — one
     # write+fsync covering EVERY peer's tick records (storage/wal.py
     # GroupCommitWAL) — and double-buffered dispatch ticks, where the
@@ -143,6 +149,7 @@ class NodeMetrics:
             "snapshots_sent": self.snapshots_sent,
             "snapshots_installed": self.snapshots_installed,
             "conf_changes_applied": self.conf_changes_applied,
+            "witness_appends": self.witness_appends,
             "wal_group_commits": self.wal_group_commits,
             "overlap_ticks": self.overlap_ticks,
             "reads": {

@@ -1,0 +1,97 @@
+"""Chaos digest pin: the committed SEED=0 histories may never drift.
+
+`make chaos SEED=0` proves a seed reproduces against ITSELF (two runs,
+one process).  This lint proves it reproduces against HISTORY: it runs
+the pinned seed's families once, in-process, and compares the schedule
+(or plan) digest and the committed-history result digest against
+bench_logs/chaos_digests.json, which is committed to the repo.
+
+A schedule/plan digest change means the seeded generator drew
+different faults — someone reordered rng draws or edited a frozen plan
+dataclass (both change every historical repro recipe).  A result
+digest change with a stable schedule digest is the serious one: the
+same faults against the same seed produced a DIFFERENT committed
+history, i.e. an engine behavior change on the default code path.
+Either way the change must be deliberate: re-pin the file in the same
+commit and say why in the commit message.
+
+    python scripts/check_digests.py            # verify (CI)
+    python scripts/check_digests.py --update   # re-pin after a
+                                               # deliberate change
+
+Exit 0 = every family matches the pin.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+PIN = os.path.join(_REPO, "bench_logs", "chaos_digests.json")
+
+
+def _families(seed: int):
+    """family name -> (report dict, schedule-digest key)."""
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.run import _run_fused, _run_quorum
+
+    yield "default", _run_fused(S.generate(seed, ticks=240)), \
+        "schedule_digest"
+    yield "quorum", _run_quorum(S.generate_quorum(seed)), \
+        "plan_digest"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the pin file from this run")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with open(PIN, encoding="utf-8") as f:
+        pinned = json.load(f)
+    seed = int(pinned["seed"])
+
+    got = {}
+    ok = True
+    for name, report, skey in _families(seed):
+        got[name] = {skey: report[skey],
+                     "result_digest": report["result_digest"]}
+        want = pinned["families"].get(name)
+        if args.update:
+            print(f"check_digests: {name}: {got[name]}")
+            continue
+        if want is None:
+            print(f"check_digests: FAIL {name}: no pin committed "
+                  f"(got {got[name]})", file=sys.stderr)
+            ok = False
+        elif want != got[name]:
+            print(f"check_digests: FAIL {name}: pinned {want} != "
+                  f"observed {got[name]} — the SEED={seed} history "
+                  f"drifted; if deliberate, re-pin with --update and "
+                  f"explain in the commit", file=sys.stderr)
+            ok = False
+        else:
+            print(f"check_digests: {name}: OK ({got[name]})")
+
+    if args.update:
+        doc = {"seed": seed, "families": got}
+        tmp = tempfile.NamedTemporaryFile(
+            "w", dir=os.path.dirname(PIN), suffix=".tmp",
+            delete=False, encoding="utf-8")
+        with tmp as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp.name, PIN)
+        print(f"check_digests: pinned {PIN}")
+        return 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,14 @@ def lint_url(host: str, port: int, label: str = "",
                        "raftsql_reads_shm_hits",
                        "raftsql_reads_shm_fallbacks",
                        "raftsql_reads_read_index_batched",
+                       # Quorum geometry: effective per-phase quorum
+                       # sizes + witness census/appends, present even
+                       # on default-geometry clusters so dashboards
+                       # can alert on a drifting config.
+                       "raftsql_quorum_write_size",
+                       "raftsql_quorum_election_size",
+                       "raftsql_quorum_witnesses",
+                       "raftsql_witness_appends",
                        ) + extra_required
     for required in required_series:
         assert any(n == required for (n, _l) in samples), \

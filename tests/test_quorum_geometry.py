@@ -1,0 +1,539 @@
+"""Quorum geometry: flexible quorums + witness peers (PR 17).
+
+Pins the whole geometry contract at every layer it crosses:
+
+  * config.py validation — the intersection invariants W + E > N and
+    2E > N are refused at construction (FPaxos §3: a leader's election
+    quorum must overlap every committed write's quorum), witness slots
+    are range/duplicate/voter-checked, and `unsafe_quorum_geometry` is
+    the only way past (the chaos falsification harness needs it).
+  * ops/quorum.py sized kernels — `mask_threshold` applies an explicit
+    size ONLY to a full mask; a reduced mask (mid membership change)
+    falls back to its own majority, because the explicit size was
+    validated against all P slots and carries no intersection
+    guarantee over a subset.
+  * the fused runtime — a witness votes, appends and fsyncs (its WAL
+    stream is real, `witness_appends` counts it) but never campaigns,
+    never leads, never publishes a commit stream, and is refused as a
+    leadership-transfer target.  SIGKILL-equivalent restart replays
+    its WAL for votes/terms/log and still publishes NOTHING.
+  * RaftDB — a witness replica never invokes the SQLite factory (no
+    shard file or directory is ever created), refuses every read up
+    front, and after a restart its WAL vote keeps the cluster writable
+    when a full voter dies (2 of 3 = leader + witness).
+  * membership/manager.py — a conf change that would re-open a
+    non-intersecting geometry, or leave only witness voters, is
+    refused across BOTH joint halves.
+  * placement + reshard — witnesses are never nominated as transfer
+    destinations and migrate-to-witness is a typed refusal.
+  * jit-stability — the quorum chaos family (partitions, crashes,
+    skew, witness cluster) feeds ONE trace of the fused step.
+"""
+import dataclasses
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from raftsql_tpu.config import RaftConfig
+
+TIMEOUT = 30.0
+
+
+# -- config validation --------------------------------------------------
+
+
+def _cfg(**kw):
+    kw.setdefault("num_groups", 1)
+    kw.setdefault("num_peers", 3)
+    kw.setdefault("tick_interval_s", 0.0)
+    return RaftConfig(**kw)
+
+
+def test_config_rejects_non_intersecting_write_election():
+    with pytest.raises(ValueError, match="must exceed num_peers"):
+        _cfg(write_quorum=1, election_quorum=2)
+    with pytest.raises(ValueError, match="non-intersecting"):
+        _cfg(num_peers=5, write_quorum=2, election_quorum=3)
+
+
+def test_config_rejects_disjoint_election_quorums():
+    # W + E > N alone is not enough: terms are shared, so two election
+    # quorums must intersect too (else two candidates win one term).
+    with pytest.raises(ValueError, match="2 \\* election_quorum"):
+        _cfg(write_quorum=3, election_quorum=1)
+
+
+def test_config_rejects_out_of_range_sizes():
+    with pytest.raises(ValueError, match="write_quorum must be in"):
+        _cfg(write_quorum=0, election_quorum=3)
+    with pytest.raises(ValueError, match="election_quorum must be in"):
+        _cfg(write_quorum=3, election_quorum=4)
+
+
+def test_config_unsafe_flag_is_the_only_bypass():
+    c = _cfg(write_quorum=1, election_quorum=2,
+             unsafe_quorum_geometry=True)
+    assert c.write_size == 1 and c.election_size == 2
+    assert not c.default_geometry
+
+
+def test_config_default_geometry_flag():
+    assert _cfg().default_geometry
+    assert _cfg().write_size == 2 and _cfg().election_size == 2
+    # Explicit majority sizes are VALID but not the default-geometry
+    # fast path: the flag keys the digest-pinned static kernels.
+    c = _cfg(write_quorum=2, election_quorum=2)
+    assert not c.default_geometry
+    assert c.write_size == 2 and c.election_size == 2
+
+
+def test_config_witness_validation():
+    with pytest.raises(ValueError, match="out of peer-slot range"):
+        _cfg(witnesses=(3,))
+    with pytest.raises(ValueError, match="duplicates"):
+        _cfg(witnesses=(2, 2))
+    with pytest.raises(ValueError, match="must be voters"):
+        _cfg(initial_voters=(0, 1), witnesses=(2,))
+    with pytest.raises(ValueError, match="non-witness"):
+        _cfg(witnesses=(0, 1, 2))
+    c = _cfg(witnesses=(2,))
+    assert c.witness_set == frozenset({2})
+    assert not c.default_geometry
+
+
+# -- sized quorum kernels (ops/quorum.py) --------------------------------
+
+
+def test_mask_threshold_full_mask_takes_explicit_size():
+    import jax.numpy as jnp
+    from raftsql_tpu.ops.quorum import mask_majority, mask_threshold
+
+    full = jnp.ones((4, 5), bool)
+    assert (mask_threshold(full, None)
+            == mask_majority(full)).all()          # None == majority
+    for size in range(1, 6):
+        assert (mask_threshold(full, size) == size).all()
+
+
+def test_mask_threshold_reduced_mask_falls_back_to_majority():
+    import jax.numpy as jnp
+    from raftsql_tpu.ops.quorum import mask_threshold
+
+    # Popcount 2 of 3: the explicit size was validated against 3 slots
+    # and guarantees nothing over a 2-slot subset — majority (2) wins.
+    m = jnp.array([[True, True, False]])
+    for size in (1, 2, 3):
+        assert int(mask_threshold(m, size)[0]) == 2
+    # Empty mask: threshold 1, which a masked tally of 0 never reaches.
+    assert int(mask_threshold(jnp.zeros((1, 3), bool), 1)[0]) == 1
+
+
+def test_masked_vote_win_with_explicit_size():
+    import jax.numpy as jnp
+    from raftsql_tpu.ops.quorum import masked_vote_win
+
+    full = jnp.ones((1, 3), bool)
+    two = jnp.array([[True, True, False]])
+    one = jnp.array([[True, False, False]])
+    # E=2 on a full 3-mask: two votes win, one loses.
+    assert bool(masked_vote_win(two, full, full, 2)[0])
+    assert not bool(masked_vote_win(one, full, full, 2)[0])
+    # E=1 (unsafe harness geometry): a single vote wins.
+    assert bool(masked_vote_win(one, full, full, 1)[0])
+    # Joint config: BOTH masks must reach the threshold.
+    joint = jnp.array([[False, True, True]])       # C_old = {1, 2}
+    assert not bool(masked_vote_win(one, full, joint, 1)[0])
+
+
+def test_masked_quorum_match_index_with_explicit_size():
+    import jax.numpy as jnp
+    from raftsql_tpu.ops.quorum import masked_quorum_match_index
+
+    match = jnp.array([[5, 3, 1]], dtype=jnp.int32)
+    full = jnp.ones((1, 3), bool)
+    assert int(masked_quorum_match_index(match, full, None)[0]) == 3
+    assert int(masked_quorum_match_index(match, full, 1)[0]) == 5
+    assert int(masked_quorum_match_index(match, full, 2)[0]) == 3
+    assert int(masked_quorum_match_index(match, full, 3)[0]) == 1
+
+
+# -- fused runtime: witness behavior ------------------------------------
+
+
+def _wcfg(groups=2):
+    return RaftConfig(num_groups=groups, num_peers=3, log_window=32,
+                      max_entries_per_msg=4, tick_interval_s=0.0,
+                      witnesses=(2,))
+
+
+def _elect(node, max_ticks=200):
+    for t in range(max_ticks):
+        node.tick()
+        if t > 10 and (node._hints >= 0).all():
+            return
+    raise AssertionError("no full leadership within budget")
+
+
+def _drain(node, peer):
+    from raftsql_tpu.runtime.db import _expand_commit_item
+    out, sentinels = [], 0
+    q = node.commit_q(peer)
+    while True:
+        try:
+            item = q.get_nowait()
+        except Exception:
+            break
+        if item is None:
+            sentinels += 1
+            continue
+        out.extend(_expand_commit_item(item))
+    return out, sentinels
+
+
+def test_fused_witness_votes_appends_never_leads_never_publishes(
+        tmp_path):
+    from raftsql_tpu.runtime.fused import FusedClusterNode
+    from raftsql_tpu.runtime.node import TransferRefused
+
+    cfg = _wcfg()
+    node = FusedClusterNode(cfg, str(tmp_path))
+    try:
+        _elect(node)
+        assert (np.asarray(node._hints) != 2).all(), \
+            "witness slot 2 won an election"
+        for p in range(3):
+            _drain(node, p)
+        for g in range(cfg.num_groups):
+            node.propose_many(g, [f"SET k{i} g{g}".encode()
+                                  for i in range(8)])
+        for _ in range(40):
+            node.tick()
+            assert (np.asarray(node._hints) != 2).all()
+        # Full voters see identical commit streams; the witness's
+        # publish queue stays EMPTY (it has no apply plane) even
+        # though its WAL appended every entry.
+        s0, _ = _drain(node, 0)
+        s1, _ = _drain(node, 1)
+        sw, _ = _drain(node, 2)
+        assert len(s0) == cfg.num_groups * 8
+        # Per-group total order matches (cross-group interleave is
+        # unordered by design — each group is its own raft).
+        for g in range(cfg.num_groups):
+            assert [(i, q) for (gg, i, q) in s0 if gg == g] \
+                == [(i, q) for (gg, i, q) in s1 if gg == g]
+        assert sw == []
+        assert node.metrics.witness_appends >= cfg.num_groups * 8
+        # Not a legal transfer destination either.
+        with pytest.raises(TransferRefused, match="witness"):
+            node.transfer_leadership(0, 2)
+    finally:
+        node.stop()
+
+
+def test_fused_witness_restart_replays_wal_publishes_nothing(tmp_path):
+    """SIGKILL-equivalent restart of the whole fused cluster: the
+    witness's WAL replay restores its vote/term/log (the cluster
+    re-elects and keeps committing over it) but re-publishes NOTHING —
+    the boot-replay path must skip the witness exactly like the live
+    publish path does."""
+    from raftsql_tpu.runtime.fused import FusedClusterNode
+
+    cfg = _wcfg(groups=1)
+    node = FusedClusterNode(cfg, str(tmp_path))
+    _elect(node)
+    _drain(node, 0)
+    node.propose_many(0, [f"SET k{i} v{i}".encode() for i in range(6)])
+    for _ in range(30):
+        node.tick()
+    live, _ = _drain(node, 0)
+    assert len(live) == 6
+    node.stop()
+    # The witness's WAL stream is real bytes on disk (slot 2 -> p3).
+    wdir = os.path.join(str(tmp_path), "p3")
+    assert any(os.path.getsize(os.path.join(r, f))
+               for r, _, fs in os.walk(wdir) for f in fs), \
+        "witness WAL dir is empty — nothing was made durable"
+
+    node2 = FusedClusterNode(cfg, str(tmp_path))
+    try:
+        # Full voters replay the committed prefix; the witness's
+        # replayed commits are cursor-advanced, never enqueued.
+        rep, sent = _drain(node2, 0)
+        assert sent == 1 and [q for (_, _, q) in rep] \
+            == [q for (_, _, q) in live]
+        repw, _ = _drain(node2, 2)
+        assert repw == []
+        _elect(node2)
+        assert (np.asarray(node2._hints) != 2).all()
+        node2.propose_many(0, [b"SET post 1"])
+        for _ in range(30):
+            node2.tick()
+        post, _ = _drain(node2, 0)
+        assert [q for (_, _, q) in post] == ["SET post 1"]
+        assert node2.metrics.witness_appends > 0
+    finally:
+        node2.stop()
+
+
+# -- RaftDB: the witness owns no SQLite shard ----------------------------
+
+
+def test_raftdb_witness_no_shard_no_reads_survives_voter_loss(tmp_path):
+    """Lockstep 3-node cluster (RaftPipe + loopback) with slot 2 a
+    witness: the SQLite factory is NEVER invoked on it (no shard file
+    ever exists), reads are refused up front, and after a witness
+    restart its replayed WAL vote keeps the cluster writable when a
+    full voter dies (leader + witness = write quorum 2 of 3)."""
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+    from raftsql_tpu.runtime.db import RaftDB
+    from raftsql_tpu.runtime.pipe import RaftPipe
+    from raftsql_tpu.transport.loopback import LoopbackHub, \
+        LoopbackTransport
+
+    tick = 0.005
+    cfg = RaftConfig(num_groups=1, num_peers=3, tick_interval_s=tick,
+                     election_ticks=10, log_window=64,
+                     max_entries_per_msg=4, witnesses=(2,))
+    hub = LoopbackHub()
+    factory_calls = []
+
+    def mk(i):
+        def factory(g, _i=i):
+            path = os.path.join(str(tmp_path), f"shard-{_i}.db")
+            factory_calls.append(_i)
+            return SQLiteStateMachine(path)
+        pipe = RaftPipe.create(
+            i + 1, 3, cfg, LoopbackTransport(hub),
+            data_dir=os.path.join(str(tmp_path), f"raftsql-{i + 1}"))
+        return RaftDB(factory, pipe, num_groups=1)
+
+    dbs = [mk(i) for i in range(3)]
+    try:
+        assert dbs[2].witness_self and not dbs[0].witness_self
+        err = dbs[0].propose(
+            "CREATE TABLE t (id int primary key asc, v text)"
+        ).wait(TIMEOUT)
+        assert err is None, err
+        assert dbs[0].propose(
+            'INSERT INTO t (v) VALUES ("a")').wait(TIMEOUT) is None
+        # Full voters serve; the witness refuses every read up front
+        # and never created a shard.
+        deadline = time.monotonic() + TIMEOUT
+        while '|a|' not in dbs[0].query("SELECT v FROM t"):
+            assert time.monotonic() < deadline
+            time.sleep(tick)
+        with pytest.raises(ValueError, match="serves no reads"):
+            dbs[2].query("SELECT v FROM t")
+        assert 2 not in factory_calls
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "shard-2.db"))
+        assert dbs[2].metrics()["quorum"] == {
+            "write_size": 2, "election_size": 2, "witnesses": 1}
+        assert dbs[2].health_doc()["witness"] is True
+
+        # Witness SIGKILL + restart: replayed WAL, still no shard.
+        dbs[2].close()
+        dbs[2] = mk(2)
+        assert 2 not in factory_calls
+        # Kill a FULL voter: the remaining quorum is leader + witness,
+        # so every further ack proves the restarted witness is voting
+        # and appending off its replayed hard state.
+        dbs[1].close()
+        dbs[1] = None
+        deadline = time.monotonic() + TIMEOUT
+        while True:
+            try:
+                e = dbs[0].propose(
+                    'INSERT INTO t (v) VALUES ("post")').wait(5.0)
+            except TimeoutError as exc:     # election still settling
+                e = exc
+            if e is None:
+                break
+            assert time.monotonic() < deadline, e
+            time.sleep(10 * tick)
+        assert dbs[2].pipe.node.metrics.witness_appends > 0
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "shard-2.db"))
+    finally:
+        for db in dbs:
+            if db is not None:
+                db.close()
+
+
+# -- membership: geometry re-validated across joint halves ---------------
+
+
+def test_membership_change_cannot_reopen_intersection_hole():
+    from raftsql_tpu.membership.manager import (MembershipError,
+                                                MembershipManager)
+
+    # Boot voters {0, 1}: a 2-slot mask uses its own majority (2, 2),
+    # so the explicit W=1/E=2 is dormant and the boot geometry is
+    # safe.  Promoting slot 2 makes the mask FULL — the explicit
+    # sizes activate and W + E <= N would lose committed writes.
+    def promote_third(mm):
+        entry = mm.make_change(0, "add", 2)     # learner first
+        mm.apply(0, 1, entry)
+        return mm.make_change(0, "promote", 2)
+
+    mm = MembershipManager(3, 1, initial_voters=(0, 1),
+                           write_quorum=1, election_quorum=2)
+    with pytest.raises(MembershipError, match="non-intersecting"):
+        promote_third(mm)
+    # The chaos harness's explicit bypass is honored here too.
+    mm2 = MembershipManager(3, 1, initial_voters=(0, 1),
+                            write_quorum=1, election_quorum=2,
+                            unsafe_geometry=True)
+    assert promote_third(mm2)
+
+
+def test_membership_change_cannot_leave_only_witness_voters():
+    from raftsql_tpu.membership.manager import (MembershipError,
+                                                MembershipManager)
+
+    mm = MembershipManager(3, 1, witnesses=(1, 2))
+    with pytest.raises(MembershipError, match="only witness voters"):
+        mm.make_change(0, "remove", 0)
+    # Removing a witness voter is fine: {0, 1} still has an applier.
+    assert mm.make_change(0, "remove", 2)
+
+
+# -- placement + reshard: witnesses are never destinations ---------------
+
+
+class _FakeEngine:
+    def __init__(self, leaders, rates, witnesses):
+        from raftsql_tpu.utils.metrics import GroupTraffic
+        self.cfg = RaftConfig(num_groups=len(leaders), num_peers=3,
+                              tick_interval_s=0.0, witnesses=witnesses)
+        self.traffic = GroupTraffic(len(leaders), alpha=1.0)
+        for g, n in enumerate(rates):
+            self.traffic.add_propose(g, n)
+        self.traffic._last_t -= 1.0       # one whole EWMA window
+        self.leaders = list(leaders)
+        self.transfers = []
+
+    def leader_of(self, g):
+        return self.leaders[g]
+
+    def transfer_leadership(self, g, target):
+        self.transfers.append((g, target))
+
+
+def test_placement_never_nominates_a_witness_target():
+    from raftsql_tpu.placement.controller import PlacementController
+
+    # Peer 2 (the witness) leads nothing — it would be the coldest
+    # slot by load, but it can never lead, so the mover must pick the
+    # coldest FULL voter (peer 1) instead.
+    eng = _FakeEngine(leaders=[0, 0, 1, 1], rates=[60, 40, 8, 0],
+                      witnesses=(2,))
+    pc = PlacementController(eng, imbalance=2.0, min_rate=1.0)
+    d = pc.evaluate()
+    assert d is not None and eng.transfers == [(1, 1)]
+    assert all(t != 2 for (_, t) in eng.transfers)
+
+
+def test_placement_all_witness_cold_side_skips_pass():
+    from raftsql_tpu.placement.controller import PlacementController
+
+    # Every non-hot slot is a witness: there is no legal destination,
+    # so the pass issues nothing rather than burning refusals.
+    eng = _FakeEngine(leaders=[0, 0], rates=[50, 30],
+                      witnesses=(1, 2))
+    pc = PlacementController(eng, imbalance=2.0, min_rate=1.0)
+    assert pc.evaluate() is None
+    assert eng.transfers == []
+
+
+def test_reshard_refuses_migrate_to_witness():
+    from raftsql_tpu.reshard.coordinator import (ReshardCoordinator,
+                                                 ReshardRefused)
+    from raftsql_tpu.reshard.keymap import KeyMap
+
+    class _Backend:
+        def journal(self, group, rec):
+            pass
+
+        def publish(self, km):
+            pass
+
+    coord = ReshardCoordinator(_Backend(), KeyMap.initial(2, 8),
+                               num_groups=2, witness_peers=(1,))
+    with pytest.raises(ReshardRefused, match="witness"):
+        coord.enqueue("migrate", 0, 1)
+    # A full-voter destination is accepted (refusal is typed, not a
+    # blanket migrate ban).
+    assert coord.enqueue("migrate", 0, 0) >= 1
+
+
+def test_build_fused_node_with_witness(tmp_path, monkeypatch):
+    """The --fused deployment with `--witness 2`: real SQL stack on a
+    2-voter+1-witness group — writes ack on W=2 (leader + either
+    remaining stream), reads serve, the geometry shows in /metrics,
+    and the witness banked real WAL appends.  Slot 0 is the fused
+    apply stream and is refused as a witness."""
+    monkeypatch.chdir(tmp_path)
+    from raftsql_tpu.server.main import build_fused_node
+
+    rdb = build_fused_node(groups=1, peers=3, tick=0.002,
+                           witnesses=(2,))
+    try:
+        assert rdb.propose("CREATE TABLE t (v text)",
+                           0).wait(30) is None
+        assert rdb.propose("INSERT INTO t (v) VALUES ('x')",
+                           0).wait(30) is None
+        assert rdb.query("SELECT v FROM t", 0) == "|x|\n"
+        assert rdb.metrics()["quorum"] == {
+            "write_size": 2, "election_size": 2, "witnesses": 1}
+        assert rdb.pipe.node.metrics.witness_appends > 0
+    finally:
+        rdb.close()
+    with pytest.raises(ValueError, match="slot 0"):
+        build_fused_node(groups=1, peers=3, witnesses=(0,))
+
+
+def test_client_read_rotation_skips_witnesses():
+    """The front router (api/client.py): a witness answers every read
+    with 400 — a terminal answer, not a retry — so the read rotation
+    must drop known witnesses, while writes (forwarded like any
+    follower) and an explicitly pinned node keep the full rotation."""
+    from raftsql_tpu.api.client import RaftSQLClient
+
+    c = RaftSQLClient([9001, 9002, 9003])
+    c._witness = {2}
+    for _ in range(6):                     # every round-robin phase
+        assert 2 not in c._order(0, None, for_read=True)
+        assert sorted(c._order(0, None)) == [0, 1, 2]   # writes
+    assert c._order(0, 2, for_read=True) == [2]         # pinned
+    # Fail open if (misconfigured) every node were a witness: an
+    # empty rotation would turn one bad sweep into total blindness.
+    c._witness = {0, 1, 2}
+    assert sorted(c._order(0, None, for_read=True)) == [0, 1, 2]
+    c.close()
+
+
+# -- jit-stability: the quorum family feeds one trace --------------------
+
+
+def test_tripwire_single_compile_quorum_family():
+    """The quorum nemesis (flexible geometry + witness cluster under
+    partitions/crashes/skew) compiles the fused step exactly once —
+    the geometry is a static config constant, so masked thresholds and
+    witness gates must never add a retrace on the chaos path."""
+    from raftsql_tpu.analysis.tripwire import JitTripwire
+    from raftsql_tpu.chaos.scenarios import QuorumChaosRunner
+    from raftsql_tpu.chaos.schedule import generate_quorum
+
+    plan = dataclasses.replace(generate_quorum(3), ticks=120)
+    tw = JitTripwire()
+    with tempfile.TemporaryDirectory(prefix="raftlint-twq-") as d:
+        QuorumChaosRunner(plan, d).run()
+    compiles = tw.compiles()
+    warm = tw.baseline("cluster_step_host") or 0
+    assert compiles.get("cluster_step_host") in \
+        ({0, 1} if warm else {1}), compiles
+    assert tw.offenders(limit=1) == {}, compiles
