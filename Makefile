@@ -5,7 +5,7 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum trace prom-lint clean
+	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum chaos-pod trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -157,6 +157,24 @@ chaos-quorum:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --quorum --seed $(SEED)
 
+# Multi-host pod chaos (raftsql_tpu/chaos/pod.py): a seeded nemesis
+# over a REAL 2-process pod (raftsql_tpu/pod/ — dry-run multi-process
+# on one box, TcpPodTransport collective, one group shard durable per
+# host).  Three incarnations per run: a propose-plane cut at one
+# origin, SIGKILL of the NON-coordinator host (pod-wide fail-stop
+# abort), SIGKILL of the COORDINATOR, then a fault-free audit
+# incarnation — every acked write must survive the merged cross-host
+# replay (durability), apply exactly once post-dedup (re-offer retry
+# tokens), and every host must fold to the identical state
+# (convergence).  Runs the seed TWICE (plan + verdict digests must
+# match), then the PREMATURE-ACK falsification pair: acks written
+# before any durability plus a scripted crash MUST be caught by the
+# durability invariant; honest acks on the same schedule must pass.
+#   make chaos-pod SEED=17
+chaos-pod:
+	$(MESH_ENV) $(PY) -m raftsql_tpu.chaos.run \
+	  --pod --seed $(SEED)
+
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random
 # SIGKILL, SIGSTOP/SIGCONT stalls, a rolling-restart storm (clean
@@ -203,7 +221,7 @@ tsan:
 	/tmp/wal_stress_tsan /tmp/wal_tsan_dir 2000
 
 clean:
-	rm -f test.out raftsql_tpu/native/_native_*.so \
+	rm -f test.out flight-*.json raftsql_tpu/native/_native_*.so \
 	      raftsql_tpu/native/_wal_stress_* raftsql_tpu/native/_http_load
 	find . -name __pycache__ -type d -exec rm -rf {} +
 

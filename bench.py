@@ -49,6 +49,11 @@ Environment knobs:
   BENCH_TOTAL_BUDGET_S          (default 1800, whole-parent wall budget)
   BENCH_SKIP_DURABLE=1 / BENCH_SKIP_SWEEP=1 / BENCH_SKIP_RULES=1
   BENCH_PROFILE  <dir>          (wrap timed runs in jax.profiler.trace)
+  BENCH_POD_PROCS=N  with BENCH_CONFIG=multichip: add the multi-host
+                 pod rung — N real `pod.dryrun --mode bench` processes
+                 over the TCP collective, reporting commits/s plus the
+                 per-host cross-host hop cost (pod_wait_ms_per_tick)
+                 next to the phase shares (BENCH_POD_TICKS overrides)
 """
 from __future__ import annotations
 
@@ -661,6 +666,69 @@ def bench_multichip(ticks: int, repeats: int,
              f"{committed / dt:,.0f} commits/s")
         best = max(best, committed / dt)
     return best
+
+
+def bench_pod_rung(procs: int, ticks: int) -> dict:
+    """BENCH_POD_PROCS=N rung of BENCH_CONFIG=multichip: N real
+    `raftsql_tpu.pod.dryrun --mode bench` processes form a pod on this
+    box (the dry-run rung — each process replicates the device step on
+    forced host CPU devices; the sharded durability and the per-tick
+    TCP collective are real).  Throughput is host 0's commits/s —
+    compute is replicated, so hosts don't sum — and pod_wait_ms_per_tick
+    is the CROSS-HOST HOP COST: collective wait per tick, reported
+    per host next to the device/durable phase shares, so the profile
+    attributes what the pod barrier adds at N hosts."""
+    import json as _json
+    import shutil
+    import socket as _socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    tmp = tempfile.mkdtemp(prefix="bench-pod-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    ticks = int(os.environ.get("BENCH_POD_TICKS", str(max(ticks, 60))))
+    outs = [os.path.join(tmp, f"h{i}.json") for i in range(procs)]
+    try:
+        children = [subprocess.Popen(
+            [_sys.executable, "-m", "raftsql_tpu.pod.dryrun",
+             "--mode", "bench", "--procs", str(procs),
+             "--proc-id", str(i),
+             "--coord", coord if procs > 1 else "",
+             "--data-dir", os.path.join(tmp, f"h{i}"),
+             "--ticks", str(ticks), "--out", outs[i]],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL) for i in range(procs)]
+        for c in children:
+            c.wait(timeout=600)
+        docs = []
+        for i, (c, o) in enumerate(zip(children, outs)):
+            if c.returncode != 0 or not os.path.exists(o):
+                return {"procs": procs,
+                        "error": f"host {i} rc={c.returncode}"}
+            with open(o, encoding="utf-8") as f:
+                docs.append(_json.load(f))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    d0 = docs[0]
+    rung = {"procs": procs, "ticks": ticks,
+            "commits_per_s": d0["commits_per_s"],
+            "pod_wait_ms_per_tick": [d["pod_wait_ms_per_tick"]
+                                     for d in docs],
+            "phase_ms_per_tick": d0["phase_ms_per_tick"],
+            "bytes_tx": sum(d["pod"]["bytes_tx"] for d in docs)}
+    if "phase_shares" in d0:
+        rung["phase_shares"] = d0["phase_shares"]
+    _log(f"  pod rung: {procs} hosts, {d0['commits_per_s']:,.0f} "
+         f"commits/s, gather wait {rung['pod_wait_ms_per_tick']} ms/tick")
+    return rung
 
 
 def bench_durable(groups: int, peers: int, ticks: int, repeats: int):
@@ -1534,8 +1602,16 @@ def run_config(config: str, cpu: bool):
                 _log(f"  multichip G={g} FAILED: "
                      f"{type(e).__name__}: {e}")
                 ladder[str(g)] = f"fault: {type(e).__name__}"
-        return best, {"mesh_ladder": ladder,
-                      "mesh_devices": len(_jax.devices())}
+        extras = {"mesh_ladder": ladder,
+                  "mesh_devices": len(_jax.devices())}
+        # BENCH_POD_PROCS=N: the multi-host pod rung — N real dry-run
+        # processes over the TCP collective, attributing the cross-host
+        # hop cost per tick (bench_pod_rung).
+        pod_procs = int(os.environ.get("BENCH_POD_PROCS", "0"))
+        if pod_procs > 0:
+            _log(f"== pod rung: {pod_procs} host processes ==")
+            extras["pod"] = bench_pod_rung(pod_procs, ticks)
+        return best, extras
     if config == "rules":
         out = bench_rules_race(groups, peers, ticks, repeats)
         vals = [v for row in out.values() for v in row.values()

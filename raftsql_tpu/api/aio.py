@@ -311,6 +311,15 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(
                 400, b"Bad Request", b"proposal not committed in time\n"))
             return
+        except NotLeaderError as e:
+            # --pod owner refusal (server/main.py PodRaftDB), parity
+            # with the threaded plane: 421 + X-Raft-Leader names the
+            # owner host so the client chases instead of erroring.
+            extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
+                if e.leader > 0 else ()
+            self._finish(_resp(421, b"Misdirected Request",
+                               (str(e) + "\n").encode(), extra=extra))
+            return
         except Exception as e:                      # noqa: BLE001
             log.info("client error: %s", e)
             if fut is not None:

@@ -167,6 +167,8 @@ class RaftSQLClient:
         self._hints_at = 0.0                   # last /healthz sweep
         self._keymap: Optional[dict] = None    # elastic-keyspace doc
         self._rr = 0                           # round-robin cursor
+        self._max_conns = max_conns_per_node   # pod-host adoption
+        self._max_idle = max_idle_per_node
         self._pools = [_NodePool(h, p, max_conns_per_node,
                                  max_idle_per_node)
                        for (h, p) in self.nodes]
@@ -240,6 +242,30 @@ class RaftSQLClient:
 
     # -- routing hints (PR 12 front router) ----------------------------
 
+    def _adopt_pod_hosts(self, hosts) -> int:
+        """A pod deployment (raftsql_tpu/pod/) publishes the full host
+        table in /healthz ("pod" section, --pod-id order); adopt every
+        not-yet-known host so a client pointed at ONE pod host learns
+        to sweep — and route to — them all.  Returns adopted count.
+        Appending under _mu is safe against concurrent raw() readers
+        (existing node indexes never move)."""
+        added = 0
+        for n in hosts:
+            host, _, port = str(n).rpartition(":")
+            try:
+                entry = (host or "127.0.0.1", int(port))
+            except ValueError:
+                continue
+            with self._mu:
+                if entry in self.nodes:
+                    continue
+                self.nodes.append(entry)
+                self._pools.append(_NodePool(entry[0], entry[1],
+                                             self._max_conns,
+                                             self._max_idle))
+                added += 1
+        return added
+
     def refresh_hints(self, timeout_s: float = 1.0) -> int:
         """Sweep GET /healthz and prime the routing tables from the
         per-group rows (runtime/db.py health_doc): a node whose row
@@ -249,37 +275,59 @@ class RaftSQLClient:
         fast path instead of paying a quorum round.  Steady state then
         has no 421 redirects at all: the first request of a fresh
         client already goes to the right node.  Returns the number of
-        groups with a usable leader hint."""
-        n = len(self.nodes)
+        groups with a usable leader hint.
+
+        Pod deployments (raftsql_tpu/pod/): a host whose /healthz
+        carries a "pod" section publishes the full pod hosts table —
+        the sweep ADOPTS any host it did not know (and walks it in
+        this same pass), and per-group routing merges by OWNERSHIP
+        instead of engine role: every pod host truthfully reports
+        every group (replicated compute), but only the owner host
+        serves a group (server/main.py PodRaftDB), so its `pod_owned`
+        rows become the group's write/lease targets."""
         leaders: Dict[int, int] = {}
         leases: Dict[int, Tuple[int, float]] = {}
         witnesses: set = set()
         answered: set = set()
         now = time.monotonic()
-        for idx in range(n):
+        idx = 0
+        while idx < len(self.nodes):   # adoption may grow the sweep
             doc = self.health(idx, timeout_s=timeout_s)
             if not doc:
+                idx += 1
                 continue
             answered.add(idx)
             if doc.get("witness"):
                 witnesses.add(idx)
+            pod = doc.get("pod")
+            if pod:
+                self._adopt_pod_hosts(pod.get("hosts") or ())
             for key, row in (doc.get("groups") or {}).items():
                 try:
                     g = int(key)
                 except (TypeError, ValueError):
+                    continue
+                if pod is not None:
+                    if row.get("pod_owned"):
+                        leaders[g] = idx     # owner host serves g
+                        lease = row.get("lease_s")
+                        if isinstance(lease, (int, float)) and lease > 0:
+                            leases[g] = (idx, now + float(lease))
                     continue
                 if row.get("role") == "leader":
                     leaders[g] = idx           # self-report wins
                 else:
                     hint = row.get("leader")
                     if isinstance(hint, int) and hint > 0:
-                        leaders.setdefault(g, (hint - 1) % n)
+                        leaders.setdefault(g,
+                                           (hint - 1) % len(self.nodes))
                 lease = row.get("lease_s")
                 if isinstance(lease, (int, float)) and lease > 0:
                     leases[g] = (idx, now + float(lease))
             # Elastic keyspace (raftsql_tpu/reshard/): adopt the
             # newest published key->group mapping seen on the sweep.
             self._note_keymap(doc.get("keymap"))
+            idx += 1
         with self._mu:
             self._leader.update(leaders)
             self._lease.update(leases)

@@ -182,6 +182,16 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                     # may still commit later; only this client gave up).
                     rdb.abandon(query, group, fut)
                     raise
+            except NotLeaderError as e:
+                # The --pod deployment refuses writes for groups owned
+                # by another pod host up front (server/main.py
+                # PodRaftDB); answer like a non-leader linearizable
+                # read so the client chases X-Raft-Leader (the 1-based
+                # slot in the pod hosts table).
+                self._send(421, (str(e) + "\n").encode("utf-8"),
+                           headers={"X-Raft-Leader": str(e.leader)}
+                           if e.leader > 0 else None)
+                return
             except Exception as e:
                 self._err(e)
                 return

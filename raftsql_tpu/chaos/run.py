@@ -5,6 +5,7 @@ scenarios and prove they reproduce.
     python -m raftsql_tpu.chaos.run --matrix --seed 0
     python -m raftsql_tpu.chaos.run --family enospc --seed 3
     python -m raftsql_tpu.chaos.run --procs --seed 0
+    python -m raftsql_tpu.chaos.run --pod --seed 0
 
 Default mode generates the seed's full ChaosSchedule (>= 2 partitions,
 >= 2 crash/restart events, >= 1 injected fsync fault, plus a torn-write
@@ -49,6 +50,16 @@ is not bit-reproducible (documented in the README fault matrix) — it
 runs once.  Exit code 0 only when every family passed every invariant
 (violations raise), every deterministic family reproduced, and each
 family's signature faults actually fired.
+
+--pod is the MULTI-HOST POD plane (`make chaos-pod`): a seeded
+nemesis over a real 2-process pod (raftsql_tpu/pod/ — host processes
+lockstepped by the TcpPodTransport collective, each durable for its
+own group shards): a propose-plane cut, SIGKILL of the
+non-coordinator host, SIGKILL of the coordinator, then a fault-free
+audit incarnation whose merged cross-host replay must hold every
+acked write exactly once on every host — plus the premature-ack
+falsification pair.  Proc-plane determinism tier (plan + verdict
+digests reproduce; committed history does not).
 """
 from __future__ import annotations
 
@@ -649,6 +660,99 @@ def run_quorum(seed: int, runs: int = 2) -> int:
     return 0 if ok else 1
 
 
+def _run_pod(plan) -> dict:
+    from raftsql_tpu.chaos.pod import PodChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-pod-") as d:
+        return PodChaosRunner(plan, d).run()
+
+
+def run_pod(seed: int, runs: int = 2) -> int:
+    """`make chaos-pod`: the multi-host pod gauntlet.
+
+    1. The pod nemesis (schedule.py generate_pod): a 2-process pod
+       (chaos/pod.py — real OS processes lockstepped by the
+       TcpPodTransport collective, one group shard durable per host)
+       runs three incarnations of an acked-write workload: a
+       propose-plane cut, SIGKILL of the non-coordinator host, SIGKILL
+       of the coordinator, then a fault-free audit incarnation.  Every
+       acked write must survive into the merged cross-host replay
+       (durability), apply exactly once post-dedup (the re-offer retry
+       tokens), and every host must fold to the identical state
+       (convergence).  The seed runs `runs` times; plan + verdict
+       digests must match (committed history crosses N real kernels —
+       the proc-plane determinism tier).
+    2. The FALSIFICATION pair (schedule.py falsification_pod_plan):
+       acks written at OFFER time (before the collective, before any
+       fsync) plus a scripted pre-durability crash MUST be caught by
+       the durability invariant as acked writes missing from the audit
+       fold — and the SAME schedule with honest post-publish acks must
+       pass, proving the harness detects exactly the premature ack,
+       not pod restarts in general.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    plan = S.generate_pod(seed)
+    reports = []
+    for run in range(runs):
+        r = _run_pod(plan)
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(r["noncoord_kills"] >= 1 and r["coord_kills"] >= 1
+                     and r["pod_lost_exits"] >= 1
+                     and r["cut_deferred"] > 0,
+                     f"pod: a scripted fault family never fired ({r})")
+        ok &= _check(r["unexpected_exits"] == 0,
+                     f"pod: a child died of something unscripted ({r})")
+        ok &= _check(r["acked"] > 0 and r["folded_keys"] > 0,
+                     f"pod: the workload never acked anything ({r})")
+    digests = {(r["plan_digest"], r["result_digest"]) for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"pod: non-reproducible verdicts: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_pod(S.falsification_pod_plan(seed, broken=True))
+            except InvariantViolation as e:
+                caught = "DURABILITY" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the PREMATURE pod ack was "
+                         "NOT caught by the durability invariant")
+    try:
+        r = _run_pod(S.falsification_pod_plan(seed, broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: honest acks "
+                           f"tripped the invariant: {e}")
+    else:
+        ok &= _check(r["crash_exits"] >= 1 and r["acked"] > 0,
+                     "falsification control: the crash point never "
+                     "fired (or nothing acked)")
+        print(json.dumps({"falsification_control": "passed",
+                          "acked": r["acked"],
+                          "crash_exits": r["crash_exits"]}))
+    if ok:
+        print(f"chaos pod ok: seed={seed} "
+              f"plan={reports[0]['plan_digest']} "
+              f"verdict={reports[0]['result_digest']} (x{runs} "
+              f"identical) falsification=caught")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -712,6 +816,12 @@ def main(argv=None) -> int:
                          " the witness-cluster family run twice + the "
                          "non-intersecting-geometry and "
                          "witness-lease falsification pairs")
+    ap.add_argument("--pod", action="store_true",
+                    help="multi-host pod nemesis (make chaos-pod): "
+                         "host SIGKILLs (non-coordinator + "
+                         "coordinator) and a propose-plane cut over a "
+                         "real 2-process pod, run twice + the "
+                         "premature-ack falsification pair")
     ap.add_argument("--no-procs", action="store_true",
                     help="with --reads/--transfers: skip the "
                          "process-plane leg")
@@ -731,6 +841,8 @@ def main(argv=None) -> int:
         return run_reshard(args.seed, runs=args.runs)
     if args.quorum:
         return run_quorum(args.seed, runs=args.runs)
+    if args.pod:
+        return run_pod(args.seed, runs=args.runs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

@@ -1,0 +1,18 @@
+"""Multi-host pod runtime: N host processes jointly own the cluster.
+
+Every plane before this package funneled through ONE Python controller
+process (ROADMAP's first open item).  The pod runtime breaks that
+assumption: N host processes — one per mesh slice — each run the SAME
+hostplane tick, lockstepped by a per-tick collective, while DURABILITY
+is sharded across hosts (each host fsyncs only the group shards it
+owns).  See pod/node.py for the execution model and its equivalence
+argument, pod/transport.py for the collective, and pod/dryrun.py for
+the dry-run rungs (`JAX_PLATFORMS=cpu`, N local processes).
+"""
+from raftsql_tpu.pod.config import POD_META, PodConfig
+from raftsql_tpu.pod.node import PodClusterNode, PodShardedWAL
+from raftsql_tpu.pod.transport import (LocalPodTransport, PodPeerLost,
+                                       TcpPodTransport)
+
+__all__ = ["POD_META", "PodConfig", "PodClusterNode", "PodShardedWAL",
+           "LocalPodTransport", "PodPeerLost", "TcpPodTransport"]

@@ -887,6 +887,19 @@ class RaftDB:
                         max(lease_fn(g) - now, 0.0), 4)
         doc = {"id": int(getattr(node, "node_id", 0)),
                "ready": True, "groups": groups}
+        # Pod deployment (raftsql_tpu/pod/): topology + ownership.  The
+        # `hosts` table lets a client pointed at ONE pod host discover
+        # the sweep set; `pod_owned` on each group row names which rows
+        # THIS host serves (compute is replicated, so every host
+        # truthfully reports every group — ownership, not role, is the
+        # routing key; api/client.py refresh_hints merges the sweep).
+        pod_fn = getattr(node, "pod_doc", None)
+        if pod_fn is not None:
+            doc["pod"] = pod_fn()
+            for g in range(self.num_groups):
+                row = groups.get(str(g))
+                if row is not None:
+                    row["pod_owned"] = bool(node.owns_group(g))
         if self.witness_self:
             # Routers and the chaos harness key off this: witnesses
             # accept writes (forwarded like any follower) but must

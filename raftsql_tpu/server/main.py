@@ -223,6 +223,119 @@ def build_mesh_node(groups: int = 8, peers: int = 3,
                   compact_every=compact_every, compact_keep=compact_keep)
 
 
+class PodRaftDB(RaftDB):
+    """RaftDB over a PodClusterNode: every group-scoped verb is served
+    ONLY by the group's owner host.
+
+    Ownership is the ack-soundness boundary, not a routing nicety:
+    (a) an HTTP write ack fires when the commit reaches THIS host's
+    publish stream, which follows THIS host's WAL fsync — on the owner
+    that is exactly "durable where the group's whole P-peer history
+    lives"; on any other host it would ack a write whose only durable
+    copy is still crossing the pod (the premature-ack hazard
+    chaos/pod.py falsifies); (b) pending-ack matching is
+    (group, query)-keyed (RaftDB._q2cb), so two hosts holding futures
+    for the same group could cross-resolve each other's writes off the
+    replicated publish stream.  Non-owners answer 421 + X-Raft-Leader
+    naming the owner host (1-based slot in the pod hosts table) and
+    the client chases, exactly like a non-leader peer in the
+    multi-process deployment (api/client.py merges ownership from the
+    /healthz sweep so steady state has no 421s at all)."""
+
+    def _pod_check(self, group: int) -> None:
+        node = self.pipe.node
+        if not node.owns_group(int(group)):
+            from raftsql_tpu.runtime.db import NotLeaderError
+            raise NotLeaderError(int(group),
+                                 node.group_owner(int(group)) + 1)
+
+    def propose(self, query, group: int = 0, *a, **kw):
+        self._pod_check(group)
+        return super().propose(query, group, *a, **kw)
+
+    def query(self, query, group: int = 0, *a, **kw):
+        self._pod_check(group)
+        return super().query(query, group, *a, **kw)
+
+    def member_change(self, group: int, op: str, peer: int) -> dict:
+        self._pod_check(group)
+        return super().member_change(group, op, peer)
+
+    def transfer(self, group: int, target: int) -> dict:
+        self._pod_check(group)
+        return super().transfer(group, target)
+
+
+def build_pod_node(groups: int = 8, peers: int = 3, tick: float = 0.01,
+                   data_prefix: str = "raftsql",
+                   group_shards: int = 0,
+                   pod_procs: int = 1, pod_id: int = 0,
+                   pod_coord: str = "", pod_hosts: tuple = (),
+                   resume: bool = False,
+                   compact_every: int = 0, compact_keep: int = 1024,
+                   wal_segment_bytes: int = 4 << 20,
+                   trace: bool = False) -> RaftDB:
+    """The --pod deployment (raftsql_tpu/pod/): N host PROCESSES
+    jointly own one cluster.  Every host runs the identical replicated
+    device step; the durable plane is sharded — this host materializes
+    WAL dirs and SQLite files only for the group shards it OWNS
+    (round-robin, pod/config.py), and the per-tick collective carries
+    cross-host proposals and is the tick+fsync barrier.  Construction
+    BLOCKS until all pod_procs processes join (the pod is one
+    program); a lost peer is pod-wide fail-stop — the engine error
+    surfaces through _watch_fatal as EXIT_CODE_FATAL, and a supervisor
+    restarts the whole pod, which rebuilds from the merged cross-host
+    replay.  Set RAFTSQL_POD_JAX_DISTRIBUTED=1 on real multi-host
+    fleets to run the device step as one jax.distributed SPMD program
+    (the dry-run default replicates it per host instead)."""
+    import os as _os
+
+    from raftsql_tpu.pod.config import PodConfig
+    from raftsql_tpu.pod.node import PodClusterNode
+    from raftsql_tpu.runtime.fused import FusedPipe
+    from raftsql_tpu.runtime.mesh import MeshConfig
+
+    pod = PodConfig(procs=pod_procs, proc_id=pod_id,
+                    coordinator=pod_coord, hosts=tuple(pod_hosts))
+    if _os.environ.get("RAFTSQL_POD_JAX_DISTRIBUTED") == "1":
+        pod.init_distributed()
+    cfg = RaftConfig(num_groups=groups, num_peers=peers,
+                     tick_interval_s=tick,
+                     wal_segment_bytes=wal_segment_bytes)
+    mc = (MeshConfig.for_groups(cfg, peer_shards=1)
+          if group_shards <= 0
+          else MeshConfig(peer_shards=1, group_shards=group_shards))
+    mc.validate(cfg)
+    logging.getLogger("raftsql.server").info(
+        "pod deployment: host %d/%d, %d groups over %d shards, "
+        "coordinator %s", pod_id, pod.procs, groups, mc.group_shards,
+        pod_coord or "(local)")
+    node = PodClusterNode(pod, cfg, f"{data_prefix}-pod{pod_id}",
+                          mc.build())
+    if trace:
+        node.enable_tracing()
+    node.start(interval_s=max(tick, 0.0005))
+    pipe = FusedPipe(node)
+    owned = {int(g) for g in node.owned_groups()}
+    db_dir = f"{data_prefix}-pod{pod_id}-db"
+
+    def sm_factory(g: int) -> SQLiteStateMachine:
+        if g not in owned:
+            # Replicated compute applies every group on every host, but
+            # this host is not the durable authority for g: fold into a
+            # throwaway in-memory replica (keeps watermarks and status
+            # truthful for /healthz) — reads and writes for g are
+            # owner-served (PodRaftDB), so no file may exist here.
+            return SQLiteStateMachine(":memory:", resume=False)
+        _os.makedirs(db_dir, exist_ok=True)
+        return SQLiteStateMachine(_os.path.join(db_dir, f"g{g}.db"),
+                                  resume=resume)
+
+    return PodRaftDB(sm_factory, pipe, num_groups=groups, resume=resume,
+                     compact_every=compact_every,
+                     compact_keep=compact_keep)
+
+
 # Exit code when the consensus engine dies of a fatal error (failed
 # fsync, injected ENOSPC, transport teardown): the etcd posture — a
 # server that can no longer participate must CRASH, visibly, rather
@@ -329,6 +442,28 @@ def main(argv=None) -> None:
     ap.add_argument("--peer-shards", type=int, default=1,
                     help="with --mesh: devices on the peers axis (the "
                          "message exchange then rides all_to_all)")
+    ap.add_argument("--pod", action="store_true",
+                    help="multi-host pod (raftsql_tpu/pod/): this "
+                         "process is ONE of --pod-procs hosts jointly "
+                         "owning the cluster — replicated device step, "
+                         "durability sharded by group shard, one "
+                         "cross-host collective per tick.  Boot blocks "
+                         "until every host joins; a lost host is "
+                         "pod-wide fail-stop (restart the whole pod)")
+    ap.add_argument("--pod-procs", type=int, default=1,
+                    help="with --pod: total host processes in the pod "
+                         "(overridden by the length of --pod-hosts)")
+    ap.add_argument("--pod-id", type=int, default=0,
+                    help="with --pod: this host (0-based; 0 runs the "
+                         "collective coordinator)")
+    ap.add_argument("--pod-coord", default="",
+                    help="with --pod: host:port the pod collective "
+                         "coordinator (host 0) listens on")
+    ap.add_argument("--pod-hosts", default="",
+                    help="with --pod: comma separated host:port HTTP "
+                         "addresses of EVERY pod host in --pod-id "
+                         "order — published at /healthz so a client "
+                         "pointed at one host sweeps the whole pod")
     ap.add_argument("--wal-group-commit", choices=("on", "off"),
                     default="on",
                     help="with --fused: coalesce every peer's per-tick "
@@ -423,7 +558,35 @@ def main(argv=None) -> None:
     # (runtime/node.py _run; SURVEY.md §5.1 — host-side profiling of
     # the serving process, the complement of the JAX profiler's device
     # traces in bench.py).
-    if args.mesh:
+    if args.pod:
+        pod_hosts = tuple(h for h in args.pod_hosts.split(",") if h)
+        if (args.write_quorum is not None
+                or args.election_quorum is not None or args.witness):
+            ap.error("--write-quorum/--election-quorum/--witness are "
+                     "not supported with --pod (the pod extends the "
+                     "mesh runtime, which refuses them too)")
+        if args.mesh or args.fused:
+            ap.error("--pod is its own deployment; drop --mesh/--fused")
+        if args.placement or args.reshard or args.workers:
+            # Replicated controllers: N hosts each running a placement/
+            # reshard controller would issue the same verbs N times;
+            # the ring worker plane has no pod story yet.  Refuse
+            # loudly rather than boot something subtly double-driven.
+            ap.error("--placement/--reshard/--workers are not "
+                     "supported with --pod yet")
+        rdb = build_pod_node(groups=args.groups, peers=args.peers,
+                             tick=args.tick,
+                             group_shards=args.group_shards,
+                             pod_procs=(len(pod_hosts) or args.pod_procs),
+                             pod_id=args.pod_id,
+                             pod_coord=args.pod_coord,
+                             pod_hosts=pod_hosts,
+                             resume=args.resume,
+                             compact_every=args.compact_every,
+                             compact_keep=args.compact_keep,
+                             wal_segment_bytes=args.wal_segment_bytes,
+                             trace=args.trace)
+    elif args.mesh:
         if (args.write_quorum is not None
                 or args.election_quorum is not None or args.witness):
             # The mesh runtime shards the GROUP axis; its geometry

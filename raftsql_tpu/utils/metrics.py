@@ -102,6 +102,20 @@ class NodeMetrics:
     # finished transfer's proposal-intake pause in ticks (power-of-2
     # buckets, keys are strings so prom_samples renders
     # transfers_stall_ticks_hist{bucket=...}).
+    # Pod plane (raftsql_tpu/pod/): the multi-host runtime's cross-host
+    # counters — collectives completed (one per tick once the pod is
+    # formed), wall time this host spent WAITING in them (the lockstep
+    # cost: slowest-host skew + the wire), proposals that arrived from
+    # ANOTHER pod host via the gather, durable-commit acks sent as a
+    # group-shard owner / received as an origin, and transport bytes.
+    # All zero outside --pod deployments.
+    pod_gathers: int = 0
+    pod_gather_wait_ms: float = 0.0
+    pod_proposals_routed: int = 0
+    pod_acks_tx: int = 0
+    pod_acks_rx: int = 0
+    pod_bytes_tx: int = 0
+    pod_bytes_rx: int = 0
     transfers_initiated: int = 0
     transfers_completed: int = 0
     transfers_aborted: int = 0
@@ -176,6 +190,15 @@ class NodeMetrics:
                 "enospc": self.faults_enospc,
                 "fsync_stalls": self.faults_fsync_stalls,
                 "skew_ticks": self.faults_skew_ticks,
+            },
+            "pod": {
+                "gathers": self.pod_gathers,
+                "gather_wait_ms": round(self.pod_gather_wait_ms, 3),
+                "proposals_routed": self.pod_proposals_routed,
+                "acks_tx": self.pod_acks_tx,
+                "acks_rx": self.pod_acks_rx,
+                "bytes_tx": self.pod_bytes_tx,
+                "bytes_rx": self.pod_bytes_rx,
             },
             "transfers": {
                 "initiated": self.transfers_initiated,

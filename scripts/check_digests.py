@@ -38,12 +38,17 @@ PIN = os.path.join(_REPO, "bench_logs", "chaos_digests.json")
 def _families(seed: int):
     """family name -> (report dict, schedule-digest key)."""
     from raftsql_tpu.chaos import schedule as S
-    from raftsql_tpu.chaos.run import _run_fused, _run_quorum
+    from raftsql_tpu.chaos.run import _run_fused, _run_pod, _run_quorum
 
     yield "default", _run_fused(S.generate(seed, ticks=240)), \
         "schedule_digest"
     yield "quorum", _run_quorum(S.generate_quorum(seed)), \
         "plan_digest"
+    # The pod family's result digest is its invariant-VERDICT digest
+    # (proc-plane determinism tier: the committed history crosses N
+    # real kernels), so the pin proves the plan drew the same faults
+    # and every invariant still passes with the same fired families.
+    yield "pod", _run_pod(S.generate_pod(seed)), "plan_digest"
 
 
 def main(argv=None) -> int:
