@@ -5,7 +5,8 @@ PY ?= python
 SEED ?= 0
 
 .PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
-	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum chaos-pod trace prom-lint clean
+	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum chaos-pod chaos-replica \
+	trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -174,6 +175,25 @@ chaos-quorum:
 chaos-pod:
 	$(MESH_ENV) $(PY) -m raftsql_tpu.chaos.run \
 	  --pod --seed $(SEED)
+
+# Read-replica tier chaos (raftsql_tpu/chaos/replica.py): a seeded
+# nemesis over a fused engine publishing the shm delta stream
+# (--replica-listen) and REAL `python -m raftsql_tpu.replica`
+# processes subscribed through nemesis-owned TCP proxies — a
+# subscription cut + heal, a replica SIGKILL + respawn, and one
+# flipped stream bit — under an acked-write workload probing session
+# and linear reads at every replica.  StaleReadNever: a 200 answer
+# below the mode's bound is the violation, a 421 refusal never is;
+# the audit requires exact convergence and the corruption surfacing
+# as a CRC failure.  Runs the seed TWICE (plan + verdict digests must
+# match), then the UNSAFE-SERVE falsification pair: a replica with
+# every fail-closed gate skipped under a never-healed cut MUST be
+# caught serving stale; the same schedule with the gates on must
+# pass by refusing.
+#   make chaos-replica SEED=17
+chaos-replica:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --replica --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

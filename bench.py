@@ -36,7 +36,11 @@ commits/sec (100k groups x 1k proposals/sec each, BASELINE.json).
 
 Environment knobs:
   BENCH_CONFIG   headline | quorum | elections | commit_scan | multichip
-                 | rules | latency | durable | all    (default headline)
+                 | rules | latency | durable | georeads | all
+                 (default headline)
+  BENCH_GEO_SECONDS / BENCH_GEO_RTT_MS / BENCH_GEO_THINK_MS
+                 georeads rung length, injected upstream RTT and the
+                 closed-loop client think time (defaults 5s, 60, 50)
   BENCH_GROUPS / BENCH_PEERS / BENCH_TICKS / BENCH_REPEATS
   BENCH_E        append batch size (headline default 32; latency sweeps
                  pin 16 via BENCH_LAT_E; BENCH_LAT_GROUPS sets their G)
@@ -1181,6 +1185,245 @@ def bench_http(groups: int, seconds: float, clients: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_georeads(seconds: float = 5.0, rtt_ms: float = 60.0,
+                   sites: int = 4, threads_per_site: int = 2,
+                   think_ms: float = 50.0):
+    """BENCH_CONFIG=georeads: the read-replica tier scaling ladder.
+
+    The geo model: `sites` client sites, each `rtt_ms` away from the
+    write tier.  One fused engine publishes the shm delta stream
+    (--replica-listen); up to 4 `python -m raftsql_tpu.replica`
+    processes subscribe.  A site with a LOCAL replica reads session
+    mode at zero injected latency; a site without one pays the
+    upstream RTT per read (injected client-side — the engine is on
+    this box).  Rungs N=1/2/4 replicas measure aggregate session
+    reads/s across all sites with a fixed watermark workload: every
+    rung converts far sites into near ones, so the ladder is the
+    read-scaling story the tier exists for.  Clients are CLOSED-LOOP
+    with a per-request think time — the geo win is latency avoided
+    per read, and an open-loop hammer on a small shared box would
+    measure CPU contention instead of it.  A replica REFUSAL (421)
+    falls back to the write tier (paying the RTT) and is counted —
+    fail-closed never subtracts from correctness, only from the rate.
+    Headline = reads/s at the 4-replica rung.
+    """
+    import http.client
+    import shutil
+    import socket
+    import subprocess as sp
+    import tempfile
+    import threading
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    groups = int(os.environ.get("BENCH_GROUPS", "2"))
+    max_replicas = 4
+    api_port = free_port()
+    stream_port = free_port()
+    http_ports = [free_port() for _ in range(max_replicas)]
+    tmp = tempfile.mkdtemp(prefix="bench-georeads-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logf = open(os.path.join(tmp, "servers.log"), "w")
+    procs = []
+    rtt_s = rtt_ms / 1e3
+    try:
+        procs.append(sp.Popen(
+            [sys.executable, "-m", "raftsql_tpu.server.main", "--fused",
+             "--port", str(api_port), "--groups", str(groups),
+             "--tick", "0.02", "--lease-ticks", "40",
+             "--replica-listen", str(stream_port)],
+            cwd=tmp, env=env, stdout=logf, stderr=logf))
+        deadline = time.monotonic() + 120
+        for g in range(groups):
+            while True:
+                if time.monotonic() > deadline:
+                    with open(os.path.join(tmp, "servers.log")) as f:
+                        tail = f.read()[-800:]
+                    raise RuntimeError("engine not ready in 120s: " + tail)
+                try:
+                    c = http.client.HTTPConnection(
+                        "127.0.0.1", api_port, timeout=10)
+                    try:
+                        c.request("PUT", "/",
+                                  body=b"CREATE TABLE t (v text)",
+                                  headers={"X-Raft-Group": str(g)})
+                        if c.getresponse().status in (204, 400):
+                            break
+                    finally:
+                        c.close()
+                except OSError:
+                    pass
+                time.sleep(0.5)
+        # The dataset + the session watermark each reader will carry.
+        wm = ["0"] * groups
+        for n in range(groups * 25):
+            g = n % groups
+            c = http.client.HTTPConnection("127.0.0.1", api_port,
+                                           timeout=10)
+            c.request("PUT", "/", body=f"INSERT INTO t VALUES ('v{n}')"
+                      .encode(), headers={"X-Raft-Group": str(g)})
+            r = c.getresponse()
+            assert r.status == 204, (r.status, r.read())
+            wm[g] = r.headers.get("X-Raft-Session", wm[g])
+            c.close()
+        # All four replicas boot once; each rung reads from a subset.
+        for i in range(max_replicas):
+            procs.append(sp.Popen(
+                [sys.executable, "-m", "raftsql_tpu.replica",
+                 "--upstream", f"127.0.0.1:{stream_port}",
+                 "--port", str(http_ports[i]),
+                 "--advertise", f"127.0.0.1:{http_ports[i]}"],
+                cwd=tmp, env=env, stdout=logf, stderr=logf))
+        deadline = time.monotonic() + 120
+        for i in range(max_replicas):
+            while True:
+                if time.monotonic() > deadline:
+                    with open(os.path.join(tmp, "servers.log")) as f:
+                        tail = f.read()[-800:]
+                    raise RuntimeError(
+                        f"replica {i} not serving in 120s: " + tail)
+                try:
+                    c = http.client.HTTPConnection(
+                        "127.0.0.1", http_ports[i], timeout=5)
+                    try:
+                        c.request("GET", "/",
+                                  body=b"SELECT count(*) FROM t",
+                                  headers={"X-Consistency": "session",
+                                           "X-Raft-Session": wm[0],
+                                           "X-Raft-Group": "0"})
+                        if c.getresponse().status == 200:
+                            break
+                    finally:
+                        c.close()
+                except OSError:
+                    pass
+                time.sleep(0.3)
+        _log(f"  engine + {max_replicas} replicas serving "
+             f"({groups} groups, rtt={rtt_ms}ms)")
+
+        think_s = think_ms / 1e3
+
+        def site_reader(site: int, idx: int, n_replicas: int,
+                        stop: list, out: list) -> None:
+            near = site < n_replicas
+            port = http_ports[site] if near else api_port
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            near_reads = far_reads = fallbacks = refusals = 0
+            it = 0
+            try:
+                while not stop:
+                    g = it % groups
+                    it += 1
+                    if not near:
+                        time.sleep(rtt_s)   # the injected upstream hop
+                    try:
+                        conn.request(
+                            "GET", "/", body=b"SELECT count(*) FROM t",
+                            headers={"X-Consistency": "session",
+                                     "X-Raft-Session": wm[g],
+                                     "X-Raft-Group": str(g)})
+                        st = conn.getresponse()
+                        st.read()
+                        status = st.status
+                    except OSError:
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=10)
+                        continue
+                    if status == 200:
+                        if near:
+                            near_reads += 1
+                        else:
+                            far_reads += 1
+                    elif near and status == 421:
+                        # Fail-closed replica: pay the trip upstream.
+                        refusals += 1
+                        time.sleep(rtt_s)
+                        ec = http.client.HTTPConnection(
+                            "127.0.0.1", api_port, timeout=10)
+                        try:
+                            ec.request(
+                                "GET", "/",
+                                body=b"SELECT count(*) FROM t",
+                                headers={"X-Consistency": "session",
+                                         "X-Raft-Session": wm[g],
+                                         "X-Raft-Group": str(g)})
+                            er = ec.getresponse()
+                            er.read()
+                            if er.status == 200:
+                                fallbacks += 1
+                        finally:
+                            ec.close()
+                    time.sleep(think_s)     # closed-loop client pacing
+            finally:
+                conn.close()
+            out[idx] = (near_reads, far_reads, fallbacks, refusals)
+
+        ladder: dict = {}
+        detail: dict = {}
+        best = 0.0
+        for n_replicas in (1, 2, 4):
+            stop: list = []
+            out: list = [None] * (sites * threads_per_site)
+            ts = []
+            for site in range(sites):
+                for k in range(threads_per_site):
+                    idx = site * threads_per_site + k
+                    ts.append(threading.Thread(
+                        target=site_reader,
+                        args=(site, idx, n_replicas, stop, out),
+                        daemon=True))
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            time.sleep(seconds)
+            stop.append(True)
+            for t in ts:
+                t.join(timeout=30)
+            dt = time.monotonic() - t0
+            rows = [r for r in out if r is not None]
+            near_reads = sum(r[0] for r in rows)
+            far_reads = sum(r[1] for r in rows)
+            fallbacks = sum(r[2] for r in rows)
+            refusals = sum(r[3] for r in rows)
+            rate = (near_reads + far_reads + fallbacks) / dt
+            best = max(best, rate)
+            ladder[str(n_replicas)] = round(rate, 1)
+            detail[str(n_replicas)] = {
+                "reads_per_s": round(rate, 1),
+                "replica_hits": near_reads, "upstream_reads": far_reads,
+                "engine_fallbacks": fallbacks, "refusals": refusals,
+                "near_sites": min(n_replicas, sites)}
+            _log(f"  georeads rung N={n_replicas}: "
+                 f"{rate:,.0f} reads/s ({near_reads} replica, "
+                 f"{far_reads} upstream, {fallbacks} fallbacks, "
+                 f"{refusals} refusals)")
+        extras = {"georeads_ladder": ladder, "georeads": detail,
+                  "injected_rtt_ms": rtt_ms, "think_ms": think_ms,
+                  "sites": sites,
+                  "threads_per_site": threads_per_site,
+                  "cpu_count": os.cpu_count()}
+        return float(ladder["4"]), extras
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:                       # noqa: BLE001
+                p.kill()
+        logf.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_durable_fused(groups: int, peers: int, ticks: int, repeats: int,
                         runtime: str = "fused"):
     """The durable path on the FUSED runtime (runtime/fused.py): all P
@@ -1624,6 +1867,11 @@ def run_config(config: str, cpu: bool):
         return bench_reads(
             peers, seconds=float(os.environ.get("BENCH_READ_SECONDS",
                                                 "2")))
+    if config == "georeads":
+        return bench_georeads(
+            seconds=float(os.environ.get("BENCH_GEO_SECONDS", "5")),
+            rtt_ms=float(os.environ.get("BENCH_GEO_RTT_MS", "60")),
+            think_ms=float(os.environ.get("BENCH_GEO_THINK_MS", "50")))
     if config == "http":
         # Two rungs: 16 clients (the reference's concurrency scale,
         # raftsql_test.go:79-90 — a LATENCY point) and a high-concurrency

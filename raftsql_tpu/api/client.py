@@ -172,9 +172,23 @@ class RaftSQLClient:
         self._pools = [_NodePool(h, p, max_conns_per_node,
                                  max_idle_per_node)
                        for (h, p) in self.nodes]
+        # Read-replica tier (raftsql_tpu/replica/): endpoints adopted
+        # from the engines' /healthz `replica.endpoints`, routed
+        # nearest-first by a measured per-endpoint RTT EWMA (CD-Raft's
+        # placement story: reads go to the closest live replica, and
+        # ANY refusal — the replicas' fail-closed 421 ladder — falls
+        # back to the write tier).  All lists are index-aligned and
+        # append-only under _mu, like the pod-host adoption above.
+        self._replicas: List[Tuple[str, int]] = []
+        self._rpools: List[_NodePool] = []
+        self._rtt: List[Optional[float]] = []  # EWMA ms, None unmeasured
+        self._ralive: List[bool] = []
+        # endpoint -> [hits, refusals]: the georeads bench's evidence
+        # of which replica served what.
+        self.replica_stats: Dict[str, List[int]] = {}
 
     def close(self) -> None:
-        for p in self._pools:
+        for p in self._pools + self._rpools:
             p.close()
 
     # -- low-level -----------------------------------------------------
@@ -189,7 +203,11 @@ class RaftSQLClient:
         connection internally; that is connection reuse mechanics, not
         policy.)"""
         t = timeout_s or self.timeout_s
-        pool = self._pools[node]
+        return self._pooled(self._pools[node], method, path,
+                            body, headers, t)
+
+    def _pooled(self, pool: _NodePool, method: str, path: str,
+                body: str, headers: Optional[dict], t: float):
         for attempt in (0, 1):
             conn, reused = pool.acquire(t)
             keep = False
@@ -266,6 +284,108 @@ class RaftSQLClient:
                 added += 1
         return added
 
+    # -- read-replica tier (raftsql_tpu/replica/) ----------------------
+
+    def _adopt_replicas(self, endpoints) -> int:
+        """Adopt replica HTTP endpoints published in an engine's
+        /healthz `replica.endpoints` (each replica advertises its own
+        via SUBSCRIBE).  Append-only under _mu: indexes never move, so
+        concurrent raw_replica callers stay valid."""
+        added = 0
+        for n in endpoints or ():
+            host, _, port = str(n).rpartition(":")
+            try:
+                entry = (host or "127.0.0.1", int(port))
+            except ValueError:
+                continue
+            with self._mu:
+                if entry in self._replicas:
+                    continue
+                self._replicas.append(entry)
+                self._rpools.append(_NodePool(entry[0], entry[1],
+                                              self._max_conns,
+                                              self._max_idle))
+                self._rtt.append(None)
+                self._ralive.append(True)
+                added += 1
+        return added
+
+    def raw_replica(self, ridx: int, method: str, path: str = "/",
+                    body: str = "", headers: Optional[dict] = None,
+                    timeout_s: Optional[float] = None):
+        """raw(), but against replica `ridx` — and every answered
+        request feeds the endpoint's RTT EWMA (the nearest-replica
+        routing signal)."""
+        t = timeout_s or self.timeout_s
+        with self._mu:
+            pool = self._rpools[ridx]
+        t0 = time.monotonic()
+        got = self._pooled(pool, method, path, body, headers, t)
+        self._note_rtt(ridx, (time.monotonic() - t0) * 1e3)
+        return got
+
+    def _note_rtt(self, ridx: int, ms: float) -> None:
+        """EWMA (alpha 0.3) of measured request wall time per replica
+        endpoint; an answer also marks the endpoint live again."""
+        with self._mu:
+            if ridx < len(self._rtt):
+                prev = self._rtt[ridx]
+                self._rtt[ridx] = ms if prev is None \
+                    else 0.7 * prev + 0.3 * ms
+                self._ralive[ridx] = True
+
+    def _replica_order(self) -> List[int]:
+        """Live replica indexes, nearest (lowest RTT EWMA) first;
+        unmeasured endpoints go last until their first probe."""
+        with self._mu:
+            pairs = sorted(
+                (self._rtt[i] if self._rtt[i] is not None
+                 else float("inf"), i)
+                for i in range(len(self._replicas)) if self._ralive[i])
+        return [i for _rtt, i in pairs]
+
+    def replica_endpoints(self) -> List[str]:
+        with self._mu:
+            return [f"{h}:{p}" for h, p in self._replicas]
+
+    def replica_rtt_ms(self) -> Dict[str, Optional[float]]:
+        with self._mu:
+            return {f"{h}:{p}": (round(self._rtt[i], 3)
+                                 if self._rtt[i] is not None else None)
+                    for i, (h, p) in enumerate(self._replicas)}
+
+    def _try_replicas(self, sql: str, group: int, headers: dict):
+        """One pass over the replica tier, nearest first: (rows,
+        watermark) on a 200, None to fall back to the write tier.  The
+        headers dict is the caller's — so session watermarks and the
+        consistency mode propagate to replicas verbatim.  A 421 is the
+        replica's fail-closed ladder refusing (stale epoch, uncovered
+        watermark, lapsed lease, stale heartbeat): record the leader
+        hint it carries and move on — the write tier is authoritative.
+        Connection errors mark the endpoint dead until the next
+        answered probe."""
+        for ridx in self._replica_order():
+            with self._mu:
+                if ridx >= len(self._replicas):
+                    continue
+                ep = "%s:%d" % self._replicas[ridx]
+            try:
+                status, hdrs, text = self.raw_replica(
+                    ridx, "GET", "/", sql, headers)
+            except _RETRYABLE_OS:
+                with self._mu:
+                    if ridx < len(self._ralive):
+                        self._ralive[ridx] = False
+                continue
+            with self._mu:
+                stats = self.replica_stats.setdefault(ep, [0, 0])
+                stats[0 if status == 200 else 1] += 1
+            if status == 200:
+                return text, self._session_of(hdrs)
+            if status == 421:
+                self._note_leader(group, hdrs)
+        return None
+
     def refresh_hints(self, timeout_s: float = 1.0) -> int:
         """Sweep GET /healthz and prime the routing tables from the
         per-group rows (runtime/db.py health_doc): a node whose row
@@ -302,6 +422,11 @@ class RaftSQLClient:
             pod = doc.get("pod")
             if pod:
                 self._adopt_pod_hosts(pod.get("hosts") or ())
+            # Read-replica tier: an engine with --replica-listen lists
+            # the HTTP endpoints its subscribers advertised.
+            rep = doc.get("replica")
+            if isinstance(rep, dict):
+                self._adopt_replicas(rep.get("endpoints") or ())
             for key, row in (doc.get("groups") or {}).items():
                 try:
                     g = int(key)
@@ -337,6 +462,19 @@ class RaftSQLClient:
             self._witness -= answered
             self._witness |= witnesses
             self._hints_at = time.monotonic()
+        # Probe adopted replicas once per sweep: seeds the RTT EWMA
+        # (nearest-first routing needs a measurement) and revives
+        # endpoints marked dead by a connection error.
+        with self._mu:
+            n_rep = len(self._replicas)
+        for ridx in range(n_rep):
+            try:
+                self.raw_replica(ridx, "GET", "/healthz",
+                                 timeout_s=timeout_s)
+            except _RETRYABLE_OS:
+                with self._mu:
+                    if ridx < len(self._ralive):
+                        self._ralive[ridx] = False
         return len(leaders)
 
     def _maybe_refresh_hints(self, group: int) -> None:
@@ -483,6 +621,16 @@ class RaftSQLClient:
         last: object = None
         if node is None:
             self._maybe_refresh_hints(group)
+            # Read-replica tier: route to the nearest live replica
+            # first (RTT EWMA, CD-Raft style).  The shared headers
+            # carry the session watermark and consistency mode
+            # verbatim; ANY refusal (the replica's fail-closed ladder
+            # answers 421, never a stale row) falls through to the
+            # write tier below.
+            if self._replicas:
+                got = self._try_replicas(sql, group, headers)
+                if got is not None:
+                    return got
         while True:
             # Linear reads chase the lease holder first: served there,
             # the read needs no quorum round at all (lease fast path).

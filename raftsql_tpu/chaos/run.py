@@ -6,6 +6,7 @@ scenarios and prove they reproduce.
     python -m raftsql_tpu.chaos.run --family enospc --seed 3
     python -m raftsql_tpu.chaos.run --procs --seed 0
     python -m raftsql_tpu.chaos.run --pod --seed 0
+    python -m raftsql_tpu.chaos.run --replica --seed 0
 
 Default mode generates the seed's full ChaosSchedule (>= 2 partitions,
 >= 2 crash/restart events, >= 1 injected fsync fault, plus a torn-write
@@ -753,6 +754,104 @@ def run_pod(seed: int, runs: int = 2) -> int:
     return 0 if ok else 1
 
 
+def _run_replica(plan) -> dict:
+    from raftsql_tpu.chaos.replica import ReplicaChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-replica-") as d:
+        return ReplicaChaosRunner(plan, d).run()
+
+
+def run_replica(seed: int, runs: int = 2) -> int:
+    """`make chaos-replica`: the read-replica tier gauntlet.
+
+    1. The replica nemesis (schedule.py generate_replica): a fused
+       engine publishing the shm delta stream (`--replica-listen`),
+       two real `python -m raftsql_tpu.replica` processes subscribed
+       through nemesis-owned TCP proxies, and a seeded fault timeline
+       — a subscription CUT + HEAL, a replica SIGKILL + respawn, and
+       one flipped stream bit — under an acked-write workload probing
+       session + linear reads at every replica.  StaleReadNever: a
+       200 answer below the mode's bound (session watermark / rows
+       acked before a linear probe began) is the violation; a 421
+       refusal never is.  The audit requires every replica to
+       converge to the exact final counts and the corruption to have
+       surfaced as a CRC failure.  Runs `runs` times; plan + verdict
+       digests must match (proc-plane determinism tier — the history
+       crosses real kernels and is not bit-stable).
+    2. The FALSIFICATION pair (schedule.py
+       falsification_replica_plan): one replica booted with
+       --unsafe-serve (every fail-closed gate skipped) under a
+       never-healed cut MUST be caught serving below an acked
+       watermark by StaleReadNever — and the SAME schedule with the
+       gates on must pass by refusing, proving the harness detects
+       exactly the missing gate, not partitions in general.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    plan = S.generate_replica(seed)
+    reports = []
+    for run in range(runs):
+        r = _run_replica(plan)
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(r["cuts"] >= 1 and r["heals"] >= 1
+                     and r["kills"] >= 1 and r["restarts"] >= 1
+                     and r["corrupts"] >= 1,
+                     f"replica: a scripted fault family never fired ({r})")
+        ok &= _check(r["acked"] > 0
+                     and r["served_session"] > 0
+                     and r["served_linear"] > 0,
+                     f"replica: the workload never served a read ({r})")
+        ok &= _check(r["refusals"] > 0,
+                     f"replica: the cut never forced a refusal ({r})")
+    digests = {(r["plan_digest"], r["result_digest"]) for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"replica: non-reproducible verdicts: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_replica(S.falsification_replica_plan(
+                    seed, broken=True))
+            except InvariantViolation as e:
+                caught = "STALE" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the gate-less replica was "
+                         "NOT caught by StaleReadNever")
+    try:
+        r = _run_replica(S.falsification_replica_plan(seed, broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the fail-closed "
+                           f"ladder tripped the invariant: {e}")
+    else:
+        ok &= _check(r["refusals"] > 0 and r["acked"] > 0,
+                     "falsification control: the cut never forced a "
+                     "refusal (or nothing acked)")
+        print(json.dumps({"falsification_control": "passed",
+                          "acked": r["acked"],
+                          "refusals": r["refusals"]}))
+    if ok:
+        print(f"chaos replica ok: seed={seed} "
+              f"plan={reports[0]['plan_digest']} "
+              f"verdict={reports[0]['result_digest']} (x{runs} "
+              f"identical) falsification=caught")
+    return 0 if ok else 1
+
+
 def run_matrix(seed: int, only=None) -> int:
     specs = _family_specs()
     ok = True
@@ -822,6 +921,13 @@ def main(argv=None) -> int:
                          "coordinator) and a propose-plane cut over a "
                          "real 2-process pod, run twice + the "
                          "premature-ack falsification pair")
+    ap.add_argument("--replica", action="store_true",
+                    help="read-replica tier nemesis (make "
+                         "chaos-replica): subscription cut/heal, "
+                         "replica SIGKILL/respawn and stream "
+                         "corruption over real replica processes, "
+                         "run twice + the unsafe-serve "
+                         "falsification pair")
     ap.add_argument("--no-procs", action="store_true",
                     help="with --reads/--transfers: skip the "
                          "process-plane leg")
@@ -843,6 +949,8 @@ def main(argv=None) -> int:
         return run_quorum(args.seed, runs=args.runs)
     if args.pod:
         return run_pod(args.seed, runs=args.runs)
+    if args.replica:
+        return run_replica(args.seed, runs=args.runs)
     if args.procs:
         return run_procs(args.seed, args.proc_ticks, runs=args.runs)
     if args.matrix or args.family:

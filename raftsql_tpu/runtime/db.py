@@ -263,6 +263,13 @@ class RaftDB:
         # (publish_deltas), snapshot installs republish the group's
         # base image.  None keeps the apply path untouched.
         self.shm = None
+        # Read-replica stream server (raftsql_tpu/replica/), attached
+        # by the server's --replica-listen flag: the shm publisher's
+        # tee framed onto TCP for remote replicas.  None keeps the
+        # engine inert; metrics() still exports the zeroed `replica`
+        # section so the series exist from boot (scripts/check_prom.py
+        # requires them).
+        self.replica_plane = None
         # Placement controller (raftsql_tpu/placement/), attached by
         # the server's --placement flag; None keeps metrics() and
         # flight bundles unchanged.
@@ -787,6 +794,16 @@ class RaftDB:
         # duration histogram, mapping epoch + active-verb gauge.
         if self.reshard is not None:
             m["reshard"] = self.reshard.metrics_doc()
+        # Read-replica tier (raftsql_tpu/replica/): stream-server
+        # counters when --replica-listen attached a plane; zeros
+        # otherwise, so the raftsql_replica_* series exist from boot
+        # on every deployment (scripts/check_prom.py requires them).
+        if self.replica_plane is not None:
+            m["replica"] = self.replica_plane.metrics_doc()
+        else:
+            m["replica"] = {"subscribers": 0, "deltas_tx": 0,
+                            "bases_tx": 0, "resyncs": 0,
+                            "refusals": 0, "lag_ms": 0}
         gcw = getattr(node, "_gcwal", None)
         if gcw is not None:
             # Group-commit batch histogram: peers coalesced per fsync
@@ -910,6 +927,15 @@ class RaftDB:
         # a /kv response reports a newer epoch.
         if self.reshard is not None:
             doc["keymap"] = self.reshard.keymap.to_doc()
+        # Read-replica tier (raftsql_tpu/replica/): stream listen port,
+        # per-subscriber applied/lag tails and — the client sweep's
+        # hook — the advertised replica HTTP endpoints, which
+        # api/client.py adopts and routes read-mode traffic to.
+        if self.replica_plane is not None:
+            try:
+                doc["replica"] = self.replica_plane.health_doc()
+            except Exception:                           # noqa: BLE001
+                pass        # readiness must never break on a gauge
         return doc
 
     def render_health(self) -> str:
@@ -985,6 +1011,12 @@ class RaftDB:
             self._q2cb.clear()
         for cb in pending:
             cb.set(RuntimeError("db closing with proposal outstanding"))
+        if self.replica_plane is not None:
+            try:
+                self.replica_plane.stop()
+            except Exception:                           # noqa: BLE001
+                pass
+            self.replica_plane = None
         err = self.pipe.close()
         self._reader.join(timeout=10)
         for sm in self._sms.values():

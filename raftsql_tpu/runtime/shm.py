@@ -128,10 +128,18 @@ class ShmSnapshotPublisher:
         self._log_off = _HDR_SIZE + num_groups * _ROW_SIZE
         size = max(size, self._log_off + (1 << 20))
         self.path = shm_path(ring_dir)
-        fd = os.open(self.path, os.O_CREAT | os.O_RDWR | os.O_TRUNC,
-                     0o600)
+        # No O_TRUNC, grow-only ftruncate: re-creating the region over
+        # a predecessor's path (engine restart with the old refresh
+        # thread still live) must never let the file size dip — a
+        # store through the old mapping while the file is momentarily
+        # short of the mapped range is SIGBUS, not an exception.  Old
+        # readers die on the epoch flip exactly as before; stale log
+        # bytes past the new head are unreachable (head moves only
+        # after its bytes are written).
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
         try:
-            os.ftruncate(fd, size)
+            if os.fstat(fd).st_size < size:
+                os.ftruncate(fd, size)
             self._mm = mmap.mmap(fd, size)
         finally:
             os.close(fd)
@@ -145,6 +153,16 @@ class ShmSnapshotPublisher:
         self.keymap_epoch = 0      # elastic-keyspace mapping version
         self._rows = [[0, 0, 0, 0, 0] for _ in range(num_groups)]
         #             applied, commit, base_index, lease_ns, leader
+        # Stream tee (replica/publisher.py): called under _lock with
+        # ("deltas", per_g) / ("base", group, index, blob) /
+        # ("keymap", epoch) the instant a record lands — and, unlike
+        # the mmap log, UNCONDITIONALLY: log overflow kills the local
+        # fast path (readers can't trust a truncated log) but the
+        # stream stays live, because subscribers are re-imaged from
+        # fresh KIND_BASE serializations, not from this log.  None
+        # (the default) keeps the publisher byte-for-byte inert.
+        self.tee: Optional[Callable] = None
+        self._serialize_of: Optional[Callable] = None
         # Deltas arriving before start() buffer here: the log must
         # open with each group's base image so a replica can never
         # replay a delta stream whose prefix it is missing.
@@ -217,6 +235,7 @@ class ShmSnapshotPublisher:
         (applied_of(g) > 0) but cannot produce an image would leave
         replicas with a truncated stream — the whole plane fails
         closed (log_full) rather than serve wrong prefixes."""
+        self._serialize_of = serialize_of    # retained for stream resyncs
         bases = {}
         for g in range(self.num_groups):
             got = serialize_of(g)
@@ -246,6 +265,7 @@ class ShmSnapshotPublisher:
         Readers install the base when it passes their replica's applied
         index and replay deltas above it."""
         with self._lock:
+            self._tee_locked("base", group, index, blob)
             if self._full:
                 return
 
@@ -264,10 +284,11 @@ class ShmSnapshotPublisher:
         with self._lock:
             if self._pending is not None:
                 self._pending.append(per_g)
-                return
+                return               # pre-start: flushed into the log
+                #                      (below any tee attach) by start()
+            self._tee_locked("deltas", per_g)
             if self._full:
                 return
-
             def writes():
                 self._run_locked(per_g)
                 self._write_table()
@@ -295,8 +316,77 @@ class ShmSnapshotPublisher:
         plane router flip).  Workers attached at an older value fail
         their shm reads closed until they refresh the mapping."""
         with self._lock:
+            self._tee_locked("keymap", int(epoch))
             self.keymap_epoch = int(epoch)
             self._publish_locked(lambda: None)
+
+    # -- stream-tee surface (replica/publisher.py) ----------------------
+
+    def _tee_locked(self, *event) -> None:
+        """Mirror one publish event to the stream tee (caller holds
+        _lock).  The tee implementation only does non-blocking bounded
+        queue puts; any failure is the stream plane's problem — it must
+        never stall or fail the apply thread."""
+        if self.tee is None:
+            return
+        try:
+            self.tee(*event)
+        except Exception:  # noqa: BLE001 -- tee must never stall applies
+            pass
+
+    def stream_register(self, fn: Callable[[], None]) -> Tuple[int, bool]:
+        """Run a subscriber-registration callback under the publisher
+        lock and return (log_head, log_full) from the same critical
+        section: every record at or below the returned head is readable
+        via read_log_records, and every event after it reaches the
+        just-registered tee queue — no gap, and any overlap is absorbed
+        by the replicas' resume-mode `index <= applied` dedup."""
+        with self._lock:
+            fn()
+            return self._log_head, self._full
+
+    def read_log_records(self, pos: int, head: int
+                         ) -> List[Tuple[int, int, int, bytes]]:
+        """Decode log records in [pos, head) as (kind, group, index,
+        payload).  Bytes below a head returned by stream_register are
+        append-only immutable, so this takes no lock and may run
+        concurrently with the writer (same argument as the reader's
+        _catch_up)."""
+        out = []
+        while pos + _REC.size <= head:
+            off = self._log_off + pos
+            ln, kind, group, index = _REC.unpack(
+                self._mm[off:off + _REC.size])
+            if pos + _REC.size + ln > head:
+                break
+            payload = bytes(self._mm[off + _REC.size:
+                                     off + _REC.size + ln])
+            pos += _REC.size + ln
+            out.append((kind, group, index, payload))
+        return out
+
+    def fresh_base(self, group: int) -> Optional[Tuple[int, bytes]]:
+        """A fresh (index, blob) image of one group for stream RESYNCs
+        (overflowed log / lapped subscriber queue).  Calls the engine
+        serializer retained by start(); that takes the state machine's
+        own lock, NOT the publisher lock — never call this while
+        holding _lock."""
+        fn = self._serialize_of
+        if fn is None:
+            return None
+        try:
+            got = fn(group)
+        except Exception:  # noqa: BLE001 -- resync just stays pending
+            return None
+        return got if got is not None and got[0] > 0 else None
+
+    def table_snapshot(self):
+        """(epoch, keymap_epoch, log_full, rows) with rows per group
+        (applied, commit, base_index, lease_deadline_ns, leader) — the
+        stream server's TABLE heartbeat source."""
+        with self._lock:
+            return (self.epoch, self.keymap_epoch, self._full,
+                    [tuple(r) for r in self._rows])
 
     def close(self) -> None:
         with self._lock:

@@ -533,6 +533,12 @@ def main(argv=None) -> None:
     ap.add_argument("--reshard-nslots", type=int, default=64,
                     help="hash slots in the key->group map (crc32 "
                          "%% nslots; fixed for the cluster's life)")
+    ap.add_argument("--replica-listen", type=int, default=0,
+                    help="publish the read-replica delta stream on "
+                         "this TCP port (raftsql_tpu/replica/): "
+                         "replicas subscribe with `python -m "
+                         "raftsql_tpu.replica --upstream host:PORT` "
+                         "and serve the read ladder remotely; 0 = off")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -648,9 +654,16 @@ def main(argv=None) -> None:
         if rdb.placement is not None:
             # split-hottest / merge-coldest verbs ride the controller.
             rdb.placement.reshard = plane
+    if args.replica_listen and args.pod:
+        ap.error("--replica-listen is not supported with --pod yet "
+                 "(the stream tee rides the single-engine shm "
+                 "publisher)")
     if args.workers > 0:
-        _serve_workers(rdb, args)
-        return
+        _serve_workers(rdb, args)    # replica plane attaches there,
+        return                       # reusing the ring's shm publisher
+    if args.replica_listen:
+        from raftsql_tpu.replica.publisher import attach_replica_plane
+        attach_replica_plane(rdb, args.replica_listen)
     if args.http_engine == "aio":
         from raftsql_tpu.api.aio import AioSQLServer
         srv = AioSQLServer(args.port, rdb)
@@ -678,6 +691,11 @@ def _serve_workers(rdb, args) -> None:
     ring_dir = f"raftsql-rings-{os.getpid()}"
     ring = RingServer(rdb, ring_dir, args.workers)
     ring.start()
+    if getattr(args, "replica_listen", 0):
+        # The ring attached the shm publisher already; the stream tee
+        # rides the same one (replica/publisher.py reuses rdb.shm).
+        from raftsql_tpu.replica.publisher import attach_replica_plane
+        attach_replica_plane(rdb, args.replica_listen)
 
     def _die_with_parent():
         # PR_SET_PDEATHSIG: a worker must not outlive its engine — a

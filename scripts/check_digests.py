@@ -38,7 +38,8 @@ PIN = os.path.join(_REPO, "bench_logs", "chaos_digests.json")
 def _families(seed: int):
     """family name -> (report dict, schedule-digest key)."""
     from raftsql_tpu.chaos import schedule as S
-    from raftsql_tpu.chaos.run import _run_fused, _run_pod, _run_quorum
+    from raftsql_tpu.chaos.run import (_run_fused, _run_pod, _run_quorum,
+                                       _run_replica)
 
     yield "default", _run_fused(S.generate(seed, ticks=240)), \
         "schedule_digest"
@@ -49,6 +50,10 @@ def _families(seed: int):
     # real kernels), so the pin proves the plan drew the same faults
     # and every invariant still passes with the same fired families.
     yield "pod", _run_pod(S.generate_pod(seed)), "plan_digest"
+    # Same determinism tier for the read-replica nemesis: plan digest
+    # + invariant verdicts + fired fault families.
+    yield "replica", _run_replica(S.generate_replica(seed)), \
+        "plan_digest"
 
 
 def main(argv=None) -> int:

@@ -304,7 +304,17 @@ def lint_fused_server(engine: str) -> None:
                      "raftsql_reshard_active",
                      "raftsql_reshard_duration_split_count",
                      "raftsql_reshard_duration_merge_count",
-                     "raftsql_reshard_duration_migrate_count"))
+                     "raftsql_reshard_duration_migrate_count",
+                     # Read-replica tier (raftsql_tpu/replica/):
+                     # stream-publisher counters, present (0) even
+                     # with --replica-listen off so dashboards can
+                     # rate() them unconditionally.
+                     "raftsql_replica_subscribers",
+                     "raftsql_replica_deltas_tx",
+                     "raftsql_replica_bases_tx",
+                     "raftsql_replica_resyncs",
+                     "raftsql_replica_refusals",
+                     "raftsql_replica_lag_ms"))
     finally:
         proc.terminate()
         try:

@@ -106,3 +106,116 @@ def test_get_rotates_on_changed_hint():
     assert c.get("select v from kv", linear=True, deadline_s=5) == "42"
     assert calls == [0, 1]
     assert c._leader[0] == 1
+
+
+# -- the read-replica tier (ISSUE 19): nearest-first routing + fallback -----
+
+
+def _with_replicas(c, n=2):
+    c._adopt_replicas([f"127.0.0.1:{20001 + i}" for i in range(n)])
+    return c
+
+
+def test_adopt_replicas_is_idempotent_append_only():
+    c = _client()
+    assert c._adopt_replicas(["h:20001", "h:20002"]) == 2
+    assert c._adopt_replicas(["h:20002", "h:20003", "junk"]) == 1
+    assert c.replica_endpoints() == ["h:20001", "h:20002", "h:20003"]
+
+
+def test_replica_order_is_rtt_ewma_nearest_first():
+    c = _with_replicas(_client(), n=3)
+    c._note_rtt(0, 12.0)
+    c._note_rtt(1, 3.0)
+    # replica 2 unmeasured: goes last until its first probe answers.
+    assert c._replica_order() == [1, 0, 2]
+    # EWMA: one slow sample must not instantly demote a near replica.
+    c._note_rtt(1, 8.0)
+    assert c._rtt[1] == 0.7 * 3.0 + 0.3 * 8.0
+    assert c._replica_order() == [1, 0, 2]
+    with c._mu:
+        c._ralive[1] = False                 # dead endpoints drop out
+    assert c._replica_order() == [0, 2]
+
+
+def test_get_session_routes_to_replica_and_carries_watermark():
+    """Satellite: the session watermark a PUT returned must reach the
+    replica verbatim (X-Raft-Session), and a 200 there never touches
+    the write tier."""
+    c = _with_replicas(_client())
+    seen = {}
+
+    def fake_raw_replica(ridx, method, path="/", body="", headers=None,
+                         timeout_s=None):
+        seen.update(headers or {}, ridx=ridx, body=body)
+        return 200, {"X-Raft-Session": "9"}, "|5|"
+
+    c.raw_replica = fake_raw_replica
+    c.raw = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("write tier dialled despite replica 200"))
+    c._hints_at = __import__("time").monotonic()   # suppress the sweep
+    rows, wm = c.get_session("SELECT count(*) FROM t",
+                             consistency="session", session=7)
+    assert rows == "|5|" and wm == 9
+    assert seen["X-Raft-Session"] == "7"
+    assert seen["X-Consistency"] == "session"
+
+
+def test_get_session_falls_back_to_write_tier_on_421():
+    """Satellite: any replica refusal (the fail-closed ladder answers
+    421) must fall through to the authoritative tier — and adopt the
+    leader hint the refusal carried."""
+    c = _with_replicas(_client())
+    order = []
+
+    def fake_raw_replica(ridx, method, path="/", body="", headers=None,
+                         timeout_s=None):
+        order.append(("replica", ridx))
+        return 421, {"X-Raft-Leader": "2"}, "replica refuses"
+
+    def fake_raw(node, method, path="/", body="", headers=None,
+                 timeout_s=None):
+        order.append(("engine", node))
+        return 200, {"X-Raft-Session": "4"}, "|1|"
+
+    c.raw_replica = fake_raw_replica
+    c.raw = fake_raw
+    c._hints_at = __import__("time").monotonic()
+    rows, wm = c.get_session("SELECT 1", consistency="session")
+    assert rows == "|1|" and wm == 4
+    # Both replicas refused, then the write tier answered — and the
+    # hint from the refusal warmed the leader cache.
+    assert order[:2] == [("replica", 0), ("replica", 1)]
+    assert order[2][0] == "engine"
+    assert c._leader[0] == 1
+    assert c.replica_stats["127.0.0.1:20001"] == [0, 1]
+
+
+def test_replica_conn_error_marks_dead_and_falls_back():
+    c = _with_replicas(_client())
+
+    def fake_raw_replica(ridx, method, path="/", body="", headers=None,
+                         timeout_s=None):
+        if ridx == 0:
+            raise ConnectionRefusedError("down")
+        return 200, {}, "|2|"
+
+    c.raw_replica = fake_raw_replica
+    c._hints_at = __import__("time").monotonic()
+    assert c.get("SELECT 1") == "|2|"
+    assert c._ralive[0] is False
+    # Dead endpoint skipped on the next pass.
+    assert c._replica_order() == [1]
+
+
+def test_refresh_hints_adopts_replica_endpoints():
+    c = _client()
+    docs = {0: {"groups": {"0": {"role": "leader"}},
+                "replica": {"endpoints": ["127.0.0.1:20007"]}}}
+    c.health = lambda idx, timeout_s=1.0: docs.get(idx)
+    probed = []
+    c.raw_replica = lambda ridx, *a, **k: probed.append(ridx) \
+        or (200, {}, "{}")
+    assert c.refresh_hints() == 1
+    assert c.replica_endpoints() == ["127.0.0.1:20007"]
+    assert probed == [0]                    # the sweep seeds the EWMA
