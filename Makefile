@@ -6,7 +6,7 @@ SEED ?= 0
 
 .PHONY: all native native-check native-sanitize test vet bench chaos chaos-membership chaos-procs \
 	chaos-mesh chaos-reads chaos-transfer chaos-reshard chaos-quorum chaos-pod chaos-replica \
-	trace prom-lint clean
+	chaos-overload trace prom-lint clean
 
 # The mesh families and tests need a multi-device platform; 8 virtual
 # CPU devices is the no-hardware testing recipe (tests/conftest.py).
@@ -194,6 +194,24 @@ chaos-pod:
 chaos-replica:
 	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
 	  --replica --seed $(SEED)
+
+# Overload-control chaos (raftsql_tpu/overload/): a seeded OPEN-LOOP
+# nemesis offering ~2x the engine's drain rate — burst windows,
+# hot-group skew, device-step deadlines on a fraction of writes,
+# slow-fsync stalls, and a mid-overload crash+restart — against the
+# bounded admission controller attached exactly as the server attaches
+# it.  Invariants: the propose backlog never exceeds the hard cap
+# (OVERLOAD-MEMORY, measured against the engine's actual queues every
+# tick), every acked write survives the restart replay, goodput clears
+# the plan's floor despite the overload, and no group starves.  The
+# seed runs TWICE (plan + result digests must match bit-for-bit),
+# then the falsification pair: the identical schedule with NO
+# admission controller MUST be caught by OVERLOAD-MEMORY, and with
+# the bounded controller must pass.
+#   make chaos-overload SEED=17
+chaos-overload:
+	JAX_PLATFORMS=cpu $(PY) -m raftsql_tpu.chaos.run \
+	  --overload --seed $(SEED)
 
 # Process-plane chaos (raftsql_tpu/chaos/proc.py): a seeded nemesis
 # over REAL server/main.py OS processes — leader-targeted + random

@@ -122,6 +122,20 @@ FAILCLOSED_REQUIRED = {
     "reshard/coordinator.py": {
         "fail-closed": ["_flip_router"],
     },
+    # Overload decisions: a fall-through in admit/check_deadline is a
+    # silently unbounded queue; one in brownout_read_path is a silent
+    # stale-mode serve.  Every path must end in an explicit
+    # return/raise.
+    "overload/admission.py": {
+        "fail-closed": ["admit", "check_deadline",
+                        "brownout_read_path"],
+    },
+    # The replica's write-fallback budget: a fall-through here admits
+    # a redirect lookup past the cap (the stampede the budget exists
+    # to shed).
+    "replica/node.py": {
+        "fail-closed": ["_admit_write"],
+    },
 }
 
 # ---------------------------------------------------------------------

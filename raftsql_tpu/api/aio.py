@@ -30,6 +30,8 @@ import logging
 import threading
 from typing import Optional
 
+from raftsql_tpu.overload import (Overloaded, retry_after_header,
+                                  retryable_refusal)
 from raftsql_tpu.runtime.db import NotLeaderError, RaftDB
 
 log = logging.getLogger("raftsql.api.aio")
@@ -53,6 +55,20 @@ def _resp(code: int, reason: bytes, body: bytes = b"",
     head.append(b"Content-Length: " + str(len(body)).encode())
     head.append(b"")
     return b"\r\n".join(head) + b"\r\n" + body
+
+
+def _refusal_resp(e: Exception) -> bytes:
+    """THE retryable-refusal response for this plane — the same
+    contract api/http.py emits via its `_refuse` helper: `Overloaded`
+    is 429 with the controller's jittered drain-rate Retry-After,
+    every other transient condition is 503 with its default; both
+    ALWAYS carry Retry-After so api/client.py holds off per-node."""
+    code, retry_s = retryable_refusal(e)
+    reason = (b"Too Many Requests" if code == 429
+              else b"Service Unavailable")
+    return _resp(code, reason, (str(e) + "\n").encode(),
+                 extra=((b"Retry-After",
+                         retry_after_header(retry_s).encode()),))
 
 
 def _session_extra(rdb, group: int) -> tuple:
@@ -226,6 +242,8 @@ class _Conn(asyncio.Protocol):
             token = None
             accept = b""
             kepoch = None
+            deadline = None
+            brownout = False
             for line in head[1:]:
                 k, _, v = line.partition(b":")
                 k = k.strip().lower()
@@ -253,6 +271,14 @@ class _Conn(asyncio.Protocol):
                     # routed by — the reshard plane fails closed on
                     # any mismatch (409 + the current keymap).
                     kepoch = int(v.strip())
+                elif k == b"x-raft-deadline-ms":
+                    # Overload plane: the client's REMAINING end-to-end
+                    # budget for this attempt, in milliseconds.
+                    deadline = float(v.strip())
+                elif k == b"x-raft-brownout":
+                    # Client consents to a session-read downgrade when
+                    # the brownout ladder engages (never silent).
+                    brownout = v.strip().lower() == b"allow"
         except (ValueError, IndexError):
             self._fail(b"malformed request\n")
             return None
@@ -267,7 +293,8 @@ class _Conn(asyncio.Protocol):
         return method, path, {"group": group, "mode": mode,
                               "session": session, "token": token,
                               "accept": accept.decode("latin-1"),
-                              "kepoch": kepoch}, body
+                              "kepoch": kepoch, "deadline": deadline,
+                              "brownout": brownout}, body
 
     def _fail(self, msg: bytes) -> None:
         self.tr.write(_resp(400, b"Bad Request", msg))
@@ -283,6 +310,19 @@ class _Conn(asyncio.Protocol):
         if self.buf and not self.closed:
             self._pump()
 
+    def _shed_expired(self, deadline_ms) -> bool:
+        """Edge shed (overload plane): a request whose budget is
+        already spent does no consensus work — 504, counted shed_edge.
+        Returns True when the request was answered here."""
+        if deadline_ms is None or deadline_ms > 0:
+            return False
+        ov = getattr(self.srv.rdb.pipe.node, "overload", None)
+        if ov is not None:
+            ov.note_shed("edge")
+        self._finish(_resp(504, b"Gateway Timeout",
+                           b"deadline exceeded (edge)\n"))
+        return True
+
     async def _do_put(self, headers: dict, body: bytes) -> None:
         rdb = self.srv.rdb
         try:
@@ -292,6 +332,9 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(400, b"Bad Request",
                                (str(e) + "\n").encode()))
             return
+        dl = headers["deadline"]
+        if self._shed_expired(dl):
+            return
         # The whole propose+await runs under the broad handling _do_get
         # uses: an unexpected exception (e.g. pipe/queue closed during
         # node shutdown) would otherwise kill this task and leave the
@@ -299,17 +342,30 @@ class _Conn(asyncio.Protocol):
         # seeing a 400 (the threaded plane's do_PUT catches everything).
         fut = None
         try:
-            fut = rdb.propose(query, group, token=headers["token"])
+            fut = rdb.propose(query, group, token=headers["token"],
+                              **({} if dl is None
+                                 else {"deadline_ms": dl}))
             afut = self.srv.loop.create_future()
             fut.add_done_callback(
                 lambda err: self.srv.bridge.deliver(afut, err))
-            err = await asyncio.wait_for(afut, self.srv.timeout_s)
+            err = await asyncio.wait_for(
+                afut, self.srv.timeout_s if dl is None
+                else min(self.srv.timeout_s, dl / 1000.0))
         except asyncio.TimeoutError:
             # Deregister the ack so it cannot leak; the statement may
             # still commit later (api/http.py's abandon contract).
             rdb.abandon(query, group, fut)
-            self._finish(_resp(
-                400, b"Bad Request", b"proposal not committed in time\n"))
+            if dl is not None:
+                ov = getattr(rdb.pipe.node, "overload", None)
+                if ov is not None:
+                    ov.note_shed("commit_wait")
+            self._finish(_refusal_resp(
+                TimeoutError("proposal not committed in time")))
+            return
+        except Overloaded as e:
+            # Admission refusal: nothing was enqueued (rdb.propose
+            # abandoned the ack) — 429 + jittered Retry-After.
+            self._finish(_refusal_resp(e))
             return
         except NotLeaderError as e:
             # --pod owner refusal (server/main.py PodRaftDB), parity
@@ -331,6 +387,11 @@ class _Conn(asyncio.Protocol):
                                (str(e) + "\n").encode()))
             return
         if err is not None:
+            if isinstance(err, Overloaded):
+                # Ring deployments surface admission refusals through
+                # the ack path (RingFuture._err) — same 429 contract.
+                self._finish(_refusal_resp(err))
+                return
             log.info("client error: %s", err)
             self._finish(_resp(400, b"Bad Request",
                                (str(err) + "\n").encode()))
@@ -455,15 +516,23 @@ class _Conn(asyncio.Protocol):
 
         fut = None
         sql, group = "", 0
+        dl = headers["deadline"]
+        served: dict = {}
         try:
             if method == b"PUT":
                 group, sql = plane.kv_put(key, body.decode("utf-8"),
                                           headers["kepoch"])
-                fut = rdb.propose(sql, group, token=headers["token"])
+                if self._shed_expired(dl):
+                    return
+                fut = rdb.propose(sql, group, token=headers["token"],
+                                  **({} if dl is None
+                                     else {"deadline_ms": dl}))
                 afut = self.srv.loop.create_future()
                 fut.add_done_callback(
                     lambda err: self.srv.bridge.deliver(afut, err))
-                err = await asyncio.wait_for(afut, self.srv.timeout_s)
+                err = await asyncio.wait_for(
+                    afut, self.srv.timeout_s if dl is None
+                    else min(self.srv.timeout_s, dl / 1000.0))
                 if err is not None:
                     raise err
                 extra = (_session_extra(rdb, group) + _epoch_extra())
@@ -472,11 +541,15 @@ class _Conn(asyncio.Protocol):
                 self._finish(head)
                 return
             group, sql = plane.kv_get(key, headers["kepoch"])
+            if self._shed_expired(dl):
+                return
             rows = await self.srv.loop.run_in_executor(
                 self.srv._read_pool, lambda: rdb.query(
                     sql, group, timeout=self.srv.timeout_s,
                     mode=headers["mode"],
-                    watermark=headers["session"]))
+                    watermark=headers["session"],
+                    deadline_ms=dl, brownout=headers["brownout"],
+                    info=served))
         except WrongEpoch as e:
             payload = (_json.dumps(
                 {"error": str(e), "keymap": plane.keymap.to_doc()},
@@ -487,14 +560,19 @@ class _Conn(asyncio.Protocol):
             return
         except FrozenSlot as e:
             # Retryable: the verb resolves and unfreezes the slot.
-            self._finish(_resp(503, b"Service Unavailable",
-                               (str(e) + "\n").encode(),
-                               extra=((b"Retry-After", b"1"),)))
+            self._finish(_refusal_resp(e))
             return
         except asyncio.TimeoutError:
             rdb.abandon(sql, group, fut)
-            self._finish(_resp(
-                400, b"Bad Request", b"proposal not committed in time\n"))
+            if dl is not None:
+                ov = getattr(rdb.pipe.node, "overload", None)
+                if ov is not None:
+                    ov.note_shed("commit_wait")
+            self._finish(_refusal_resp(
+                TimeoutError("proposal not committed in time")))
+            return
+        except Overloaded as e:
+            self._finish(_refusal_resp(e))
             return
         except NotLeaderError as e:
             extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
@@ -503,8 +581,7 @@ class _Conn(asyncio.Protocol):
                                (str(e) + "\n").encode(), extra=extra))
             return
         except TimeoutError as e:
-            self._finish(_resp(503, b"Service Unavailable",
-                               (str(e) + "\n").encode()))
+            self._finish(_refusal_resp(e))
             return
         except Exception as e:                      # noqa: BLE001
             log.info("client error: %s", e)
@@ -517,6 +594,9 @@ class _Conn(asyncio.Protocol):
                                (str(e) + "\n").encode()))
             return
         extra = _session_extra(rdb, group) + _epoch_extra()
+        if served.get("served"):
+            extra = extra + ((b"X-Raft-Served-Mode",
+                              served["served"].encode()),)
         val = plane.kv_value(rows)
         if val is None:
             self._finish(_resp(404, b"Not Found", b"", extra=extra))
@@ -533,6 +613,10 @@ class _Conn(asyncio.Protocol):
             self._finish(_resp(400, b"Bad Request",
                                (str(e) + "\n").encode()))
             return
+        dl = headers["deadline"]
+        if self._shed_expired(dl):
+            return
+        served: dict = {}
         try:
             # Reads block (SQLite, and linear/session reads wait out a
             # quorum round or a watermark) — off the loop thread.
@@ -540,7 +624,14 @@ class _Conn(asyncio.Protocol):
                 self.srv._read_pool, lambda: rdb.query(
                     query, group, timeout=self.srv.timeout_s,
                     mode=headers["mode"],
-                    watermark=headers["session"]))
+                    watermark=headers["session"],
+                    deadline_ms=dl, brownout=headers["brownout"],
+                    info=served))
+        except Overloaded as e:
+            # Admission refusal or brownout without opt-in: 429 +
+            # jittered Retry-After — never a silent downgrade.
+            self._finish(_refusal_resp(e))
+            return
         except NotLeaderError as e:
             extra = ((b"X-Raft-Leader", str(e.leader).encode()),) \
                 if e.leader > 0 else ()
@@ -548,16 +639,21 @@ class _Conn(asyncio.Protocol):
                                (str(e) + "\n").encode(), extra=extra))
             return
         except TimeoutError as e:
-            self._finish(_resp(503, b"Service Unavailable",
-                               (str(e) + "\n").encode()))
+            self._finish(_refusal_resp(e))
             return
         except Exception as e:                      # noqa: BLE001
             log.info("client error: %s", e)
             self._finish(_resp(400, b"Bad Request",
                                (str(e) + "\n").encode()))
             return
+        extra = _session_extra(rdb, group)
+        if served.get("served"):
+            # The brownout contract: the response always names the
+            # mode it was actually served at.
+            extra = extra + ((b"X-Raft-Served-Mode",
+                              served["served"].encode()),)
         self._finish(_resp(200, b"OK", rows.encode("utf-8"),
-                           extra=_session_extra(rdb, group)))
+                           extra=extra))
 
 
 class AioSQLServer:

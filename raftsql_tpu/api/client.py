@@ -158,6 +158,11 @@ class RaftSQLClient:
         self._rng = rng or random.Random()
         self._mu = threading.Lock()            # leader cache + rr cursor
         self._leader: Dict[int, int] = {}      # group -> node index
+        # Overload plane (raftsql_tpu/overload/): node index -> the
+        # monotonic time a 429/503 Retry-After holds that node out of
+        # the rotation until.  Per-node, so one saturated engine is
+        # avoided while its peers keep serving — never a retry storm.
+        self._holdoff: Dict[int, float] = {}
         self._lease: Dict[int, Tuple[int, float]] = {}
         #   group -> (node index, monotonic lease-hint expiry)
         # Witness replicas (config.py quorum geometry): they accept
@@ -244,11 +249,16 @@ class RaftSQLClient:
         if node is not None:
             return [node]
         n = len(self.nodes)
+        now = time.monotonic()
         with self._mu:
             start = self._rr % n
             self._rr += 1
             lead = self._leader.get(group)
-            skip = set(self._witness) if for_read else ()
+            skip = set(self._witness) if for_read else set()
+            # Retry-After holdoff: a node that refused with 429/503
+            # stays out of the rotation until its estimate passes —
+            # unless that would empty it (then desperation wins).
+            skip |= {i for i, t in self._holdoff.items() if t > now}
         order = [(start + i) % n for i in range(n)
                  if (start + i) % n not in skip] \
             or [(start + i) % n for i in range(n)]
@@ -517,6 +527,25 @@ class RaftSQLClient:
             self._leader.pop(group, None)
         return False
 
+    def _note_retry_after(self, idx: int, headers: dict) -> None:
+        """Honor a 429/503 Retry-After (decimal seconds): hold THIS
+        node out of the rotation until the server's estimate passes.
+        Other nodes are still tried immediately — per-node backoff,
+        not a global stall."""
+        ra = headers.get("Retry-After")
+        if not ra:
+            return
+        try:
+            delay = min(float(ra), 30.0)
+        except ValueError:
+            return
+        if delay <= 0:
+            return
+        until = time.monotonic() + delay
+        with self._mu:
+            if until > self._holdoff.get(idx, 0.0):
+                self._holdoff[idx] = until
+
     def _sleep_backoff(self, attempt: int, deadline: float) -> bool:
         """Jittered exponential backoff; False when the deadline would
         pass before the sleep ends."""
@@ -560,6 +589,14 @@ class RaftSQLClient:
             self._maybe_refresh_hints(group)
         while True:
             for idx in self._order(group, node):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break     # fail fast below — no network round trip
+                # End-to-end deadline propagation: the server sheds
+                # this attempt once the budget is spent (edge / ring /
+                # stage) instead of burning WAL cost on a dead request.
+                headers["X-Raft-Deadline-Ms"] = str(
+                    max(int(remaining * 1000), 1))
                 try:
                     status, hdrs, text = self.raw(
                         idx, "PUT", "/", sql, headers)
@@ -577,6 +614,8 @@ class RaftSQLClient:
                     if self._note_leader(group, hdrs) and node is None:
                         last = (status, text.strip())
                         break
+                if status in (429, 503):
+                    self._note_retry_after(idx, hdrs)
                 last = (status, text.strip())
             attempt += 1
             if time.monotonic() >= deadline \
@@ -638,6 +677,11 @@ class RaftSQLClient:
                       if consistency == "linear" else None)
             for idx in self._order(group, node, prefer=prefer,
                                    for_read=True):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break     # fail fast below — no network round trip
+                headers["X-Raft-Deadline-Ms"] = str(
+                    max(int(remaining * 1000), 1))
                 try:
                     status, hdrs, text = self.raw(
                         idx, "GET", "/", sql, headers)
@@ -653,6 +697,8 @@ class RaftSQLClient:
                     # immediately (no backoff — the leader is up).
                     if self._note_leader(group, hdrs) and node is None:
                         break
+                if status in (429, 503):
+                    self._note_retry_after(idx, hdrs)
                 last = (status, text.strip())
             attempt += 1
             if time.monotonic() >= deadline \
@@ -760,6 +806,8 @@ class RaftSQLClient:
                     break              # re-route under the new mapping
                 if status == 400:
                     raise SQLError(status, text)
+                if status in (429, 503):
+                    self._note_retry_after(idx, hdrs)
                 last = (status, text.strip())
             attempt += 1
             if time.monotonic() >= deadline \
@@ -803,6 +851,8 @@ class RaftSQLClient:
                     break
                 if status == 400:
                     raise SQLError(status, text)
+                if status in (429, 503):
+                    self._note_retry_after(idx, hdrs)
                 last = (status, text.strip())
             attempt += 1
             if time.monotonic() >= deadline \

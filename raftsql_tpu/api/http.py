@@ -27,6 +27,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from raftsql_tpu.overload import (Overloaded, retry_after_header,
+                                  retryable_refusal)
 from raftsql_tpu.runtime.db import NotLeaderError, RaftDB
 
 log = logging.getLogger("raftsql_tpu.http")
@@ -75,6 +77,43 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             log.info("client error: %s", msg)
             self._send(400, (msg + "\n").encode("utf-8"))
 
+        def _refuse(self, e: Exception) -> None:
+            """THE retryable-refusal path for this plane: `Overloaded`
+            becomes 429 with the controller's jittered drain-rate
+            Retry-After, every other transient condition becomes 503
+            with its default — both ALWAYS carry Retry-After, so
+            api/client.py can hold off per-node instead of hammering
+            the rotation (the aio plane emits the identical contract
+            via the same overload helpers)."""
+            code, retry_s = retryable_refusal(e)
+            self._send(code, (str(e) + "\n").encode("utf-8"),
+                       headers={"Retry-After":
+                                retry_after_header(retry_s)})
+
+        def _deadline_ms(self) -> Optional[float]:
+            """X-Raft-Deadline-Ms: the client's REMAINING end-to-end
+            budget for this attempt, in milliseconds."""
+            d = self.headers.get("X-Raft-Deadline-Ms")
+            return float(d) if d is not None else None
+
+        def _shed_expired(self, deadline_ms: Optional[float]) -> bool:
+            """Edge shed: a request whose budget is already spent does
+            no consensus work at all — 504, counted shed_edge.
+            Returns True when the request was answered here."""
+            if deadline_ms is None or deadline_ms > 0:
+                return False
+            ov = getattr(rdb.pipe.node, "overload", None)
+            if ov is not None:
+                ov.note_shed("edge")
+            self._send(504, b"deadline exceeded (edge)\n")
+            return True
+
+        def _brownout_ok(self) -> bool:
+            """X-Raft-Brownout: allow — the client consents to a
+            session-read downgrade when the brownout ladder engages."""
+            return (self.headers.get("X-Raft-Brownout", "")
+                    .strip().lower() == "allow")
+
         def _retry_token(self) -> Optional[int]:
             """X-Raft-Retry-Token: hex u64 pinning the proposal's
             envelope id so a client-side re-send applies exactly once
@@ -110,8 +149,7 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 return True
             if isinstance(e, FrozenSlot):
                 # Retryable: the verb resolves and unfreezes the slot.
-                self._send(503, (str(e) + "\n").encode("utf-8"),
-                           headers={"Retry-After": "1"})
+                self._refuse(e)
                 return True
             return False
 
@@ -124,14 +162,21 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 self._send(503, b"no reshard plane (--reshard)\n")
                 return
             plane = rdb.reshard
+            served: dict = {}
             try:
+                dl = self._deadline_ms()
                 if self.command == "PUT":
                     group, sql = plane.kv_put(key, self._body(),
                                               self._epoch_hdr())
+                    if self._shed_expired(dl):
+                        return
                     fut = rdb.propose(sql, group,
-                                      token=self._retry_token())
+                                      token=self._retry_token(),
+                                      **({} if dl is None
+                                         else {"deadline_ms": dl}))
                     try:
-                        err = fut.wait(timeout_s)
+                        err = fut.wait(timeout_s if dl is None
+                                       else min(timeout_s, dl / 1000.0))
                     except TimeoutError:
                         rdb.abandon(sql, group, fut)
                         raise
@@ -146,15 +191,23 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                         .lower() or "local")
                 wm = int(self.headers.get("X-Raft-Session") or 0)
                 self._body()    # drain — keep-alive
+                if self._shed_expired(dl):
+                    return
                 rows = rdb.query(sql, group, timeout=timeout_s,
-                                 mode=mode, watermark=wm)
+                                 mode=mode, watermark=wm,
+                                 deadline_ms=dl,
+                                 brownout=self._brownout_ok(),
+                                 info=served)
+            except Overloaded as e:
+                self._refuse(e)
+                return
             except NotLeaderError as e:
                 self._send(421, (str(e) + "\n").encode("utf-8"),
                            headers={"X-Raft-Leader": str(e.leader)}
                            if e.leader > 0 else None)
                 return
             except TimeoutError as e:
-                self._send(503, (str(e) + "\n").encode("utf-8"))
+                self._refuse(e)
                 return
             except Exception as e:
                 if not self._kv_refused(e):
@@ -162,6 +215,8 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 return
             hdrs = _session_headers(rdb, group) or {}
             hdrs["X-Raft-Keymap-Epoch"] = str(plane.keymap.epoch)
+            if served.get("served"):
+                hdrs["X-Raft-Served-Mode"] = served["served"]
             val = plane.kv_value(rows)
             if val is None:
                 self._send(404, b"", headers=hdrs)
@@ -174,14 +229,27 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                 return
             try:
                 query, group = self._body(), self._group()
-                fut = rdb.propose(query, group, token=self._retry_token())
+                dl = self._deadline_ms()
+                if self._shed_expired(dl):
+                    return
+                fut = rdb.propose(query, group, token=self._retry_token(),
+                                  **({} if dl is None
+                                     else {"deadline_ms": dl}))
                 try:
-                    err = fut.wait(timeout_s)
+                    err = fut.wait(timeout_s if dl is None
+                                   else min(timeout_s, dl / 1000.0))
                 except TimeoutError:
                     # Deregister the ack so it cannot leak (the statement
                     # may still commit later; only this client gave up).
                     rdb.abandon(query, group, fut)
+                    if dl is not None:
+                        ov = getattr(rdb.pipe.node, "overload", None)
+                        if ov is not None:
+                            ov.note_shed("commit_wait")
                     raise
+            except Overloaded as e:
+                self._refuse(e)
+                return
             except NotLeaderError as e:
                 # The --pod deployment refuses writes for groups owned
                 # by another pod host up front (server/main.py
@@ -192,11 +260,19 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                            headers={"X-Raft-Leader": str(e.leader)}
                            if e.leader > 0 else None)
                 return
+            except TimeoutError as e:
+                # Retryable: commit or apply did not land in budget —
+                # 503 + Retry-After via the unified refusal helper.
+                self._refuse(e)
+                return
             except Exception as e:
                 self._err(e)
                 return
             if err is not None:
-                self._err(err)
+                if isinstance(err, Overloaded):
+                    self._refuse(err)
+                else:
+                    self._err(err)
             else:
                 # The ack implies local apply: the watermark echoed
                 # here covers this very write (X-Raft-Session —
@@ -260,8 +336,21 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
                         .lower() or "local")
                 wm = int(self.headers.get("X-Raft-Session") or 0)
                 group = self._group()
-                rows = rdb.query(self._body(), group, timeout=timeout_s,
-                                 mode=mode, watermark=wm)
+                body = self._body()
+                dl = self._deadline_ms()
+                if self._shed_expired(dl):
+                    return
+                served: dict = {}
+                rows = rdb.query(body, group, timeout=timeout_s,
+                                 mode=mode, watermark=wm,
+                                 deadline_ms=dl,
+                                 brownout=self._brownout_ok(),
+                                 info=served)
+            except Overloaded as e:
+                # Admission refusal or brownout without opt-in: 429 +
+                # jittered Retry-After — never a silent downgrade.
+                self._refuse(e)
+                return
             except NotLeaderError as e:
                 # 421 Misdirected Request + the leader hint: the client
                 # retries its linearizable read against that node.
@@ -272,15 +361,19 @@ def _make_handler(rdb: RaftDB, timeout_s: float):
             except TimeoutError as e:
                 # Transient server-side condition (quorum unreachable or
                 # apply lagging) — retryable, NOT a client error.
-                self._send(503, (str(e) + "\n").encode("utf-8"))
+                self._refuse(e)
                 return
             except Exception as e:
                 self._err(e)
                 return
             # Commit-watermark echo: the client's next session read
             # presents this to get read-your-writes anywhere.
-            self._send(200, rows.encode("utf-8"),
-                       headers=_session_headers(rdb, group))
+            hdrs = _session_headers(rdb, group) or {}
+            if served.get("served"):
+                # The brownout contract: the response always names the
+                # mode it was actually served at.
+                hdrs["X-Raft-Served-Mode"] = served["served"]
+            self._send(200, rows.encode("utf-8"), headers=hdrs)
 
         def _method_not_allowed(self):
             self._body()    # drain — a leftover body corrupts keep-alive

@@ -661,6 +661,119 @@ def run_quorum(seed: int, runs: int = 2) -> int:
     return 0 if ok else 1
 
 
+def _run_overload(plan) -> dict:
+    from raftsql_tpu.chaos.scenarios import OverloadChaosRunner
+    with tempfile.TemporaryDirectory(prefix="raftsql-chaos-") as d:
+        return OverloadChaosRunner(plan, d).run()
+
+
+def run_overload(seed: int, runs: int = 2) -> int:
+    """`make chaos-overload`: the overload-control gauntlet.
+
+    1. The overload nemesis (schedule.py generate_overload): an
+       open-loop producer offers ~2x the engine's drain rate — with
+       burst windows, hot-group skew, device-step deadlines on a
+       fraction of writes, slow-fsync stalls and a mid-overload
+       crash+restart — against the bounded admission controller
+       attached exactly as the server attaches it.  Run `runs` times:
+       plan + result digests must reproduce, the propose backlog must
+       never exceed the hard cap (OVERLOAD-MEMORY, measured against
+       the engine's actual queues every tick), every acked write must
+       survive the restart replay (the standing durability ledger),
+       refusals and deadline stage-sheds must actually fire, goodput
+       must clear the plan's floor despite the 2x offered load, and
+       no group may be starved below the per-group floor.
+    2. The FALSIFICATION pair (schedule.py
+       falsification_overload_plan): the identical sustained-2x
+       schedule with NO admission controller attached MUST be caught
+       by OVERLOAD-MEMORY within the run, and the SAME schedule with
+       the bounded controller must pass — proving the harness detects
+       exactly the missing admission bound, not offered load in
+       general.
+    """
+    from raftsql_tpu.chaos import schedule as S
+    from raftsql_tpu.chaos.invariants import InvariantViolation
+
+    ok = True
+    plan = S.generate_overload(seed)
+    reports = []
+    for run in range(runs):
+        r = _run_overload(plan)
+        r["run"] = run
+        reports.append(r)
+        print(json.dumps(r, sort_keys=True))
+        ok &= _check(r["overload_rejected"] > 0
+                     and r["overload_shed_stage"] > 0
+                     and r["fsync_stalls"] > 0 and r["crashes"] >= 1,
+                     f"overload: a pressure family never fired ({r})")
+        ok &= _check(r["overload_depth_peak"] <= plan.total_cap,
+                     f"overload: backlog peak "
+                     f"{r['overload_depth_peak']} exceeded the cap "
+                     f"{plan.total_cap} without tripping the "
+                     f"invariant ({r})")
+        ok &= _check(
+            r["committed_entries"] >= plan.goodput_floor * plan.ticks,
+            f"overload: goodput floor missed — "
+            f"{r['committed_entries']} committed < "
+            f"{plan.goodput_floor * plan.ticks} ({r})")
+        ok &= _check(
+            min(r["group_commits"]) >= plan.starvation_floor,
+            f"overload: a group starved — per-group commits "
+            f"{r['group_commits']} < floor {plan.starvation_floor} "
+            f"({r})")
+    digests = {(r["plan_digest"], r["result_digest"]) for r in reports}
+    ok &= _check(len(digests) == 1,
+                 f"overload: non-reproducible: {digests}")
+
+    # Falsification sensitivity proof.  The violation is EXPECTED —
+    # route its flight bundle to a temp dir instead of littering cwd.
+    caught = False
+    flight_prev = os.environ.get("RAFTSQL_FLIGHT_DIR")
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="raftsql-falsification-") as fd:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = fd
+            try:
+                _run_overload(
+                    S.falsification_overload_plan(seed, broken=True))
+            except InvariantViolation as e:
+                caught = "OVERLOAD-MEMORY" in str(e)
+                print(json.dumps({"falsification": "caught",
+                                  "violation": str(e)}))
+    finally:
+        if flight_prev is None:
+            os.environ.pop("RAFTSQL_FLIGHT_DIR", None)
+        else:
+            os.environ["RAFTSQL_FLIGHT_DIR"] = flight_prev
+    ok &= _check(caught, "falsification: the UNBOUNDED propose queue "
+                         "was NOT caught by OVERLOAD-MEMORY")
+    try:
+        r = _run_overload(
+            S.falsification_overload_plan(seed, broken=False))
+    except InvariantViolation as e:
+        ok = _check(False, f"falsification control: the BOUNDED "
+                           f"admission control tripped the invariant: "
+                           f"{e}")
+    else:
+        ok &= _check(r["committed_entries"] > 0
+                     and r["overload_rejected"] > 0,
+                     "falsification control: nothing committed (or "
+                     "nothing refused) under bounded admission")
+        print(json.dumps({"falsification_control": "passed",
+                          "committed": r["committed_entries"],
+                          "rejected": r["overload_rejected"]}))
+    if ok:
+        print(f"chaos overload ok: seed={seed} "
+              f"plan={reports[0]['plan_digest']} "
+              f"result={reports[0]['result_digest']} "
+              f"rejected={reports[0]['overload_rejected']} "
+              f"shed_stage={reports[0]['overload_shed_stage']} "
+              f"depth_peak={reports[0]['overload_depth_peak']}"
+              f"/{plan.total_cap} (x{runs} identical) "
+              f"falsification=caught")
+    return 0 if ok else 1
+
+
 def _run_pod(plan) -> dict:
     from raftsql_tpu.chaos.pod import PodChaosRunner
     with tempfile.TemporaryDirectory(prefix="raftsql-pod-") as d:
@@ -915,6 +1028,13 @@ def main(argv=None) -> int:
                          " the witness-cluster family run twice + the "
                          "non-intersecting-geometry and "
                          "witness-lease falsification pairs")
+    ap.add_argument("--overload", action="store_true",
+                    help="overload-control nemesis (make "
+                         "chaos-overload): open-loop 2x offered load "
+                         "with bursts, hot-group skew, deadlines and "
+                         "slow-fsync stalls against the bounded "
+                         "admission controller, run twice + the "
+                         "no-admission falsification pair")
     ap.add_argument("--pod", action="store_true",
                     help="multi-host pod nemesis (make chaos-pod): "
                          "host SIGKILLs (non-coordinator + "
@@ -947,6 +1067,8 @@ def main(argv=None) -> int:
         return run_reshard(args.seed, runs=args.runs)
     if args.quorum:
         return run_quorum(args.seed, runs=args.runs)
+    if args.overload:
+        return run_overload(args.seed, runs=args.runs)
     if args.pod:
         return run_pod(args.seed, runs=args.runs)
     if args.replica:

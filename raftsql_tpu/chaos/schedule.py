@@ -1554,3 +1554,112 @@ def falsification_pod_plan(seed: int = 0,
     return PodChaosPlan(seed=seed, ticks=24, procs=2, peers=3,
                         groups=4, group_shards=2,
                         unsafe_ack=broken, crash_at=12)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadBurst:
+    """An offered-load burst: `extra` additional open-loop writes per
+    tick while the window is active, on top of the plan's baseline."""
+    start: int
+    end: int
+    extra: int
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadNemesisPlan:
+    """Scripted overload attack (fused plane, chaos/scenarios.py
+    OverloadChaosRunner): an OPEN-LOOP workload offers far more writes
+    per tick than the engine can drain (offered >> capacity), in
+    bursts, with hot-group skew and slow-fsync stalls — while the
+    bounded admission controller (raftsql_tpu/overload/) is the only
+    thing standing between the propose queues and unbounded memory.
+
+    A SEPARATE plan class on purpose (same rule as every other
+    family): extending ChaosSchedule would change the asdict() digest
+    of every committed family.  The runner projects the fault fields
+    into a ChaosSchedule internally and drives the offered load
+    itself.
+
+    `unsafe_no_admission` is the falsification seam: the runner then
+    attaches NO controller, and the OVERLOAD-MEMORY invariant (propose
+    backlog > total_cap, measured against the engine's actual queues
+    every tick) MUST catch the identical schedule that the bounded
+    control survives."""
+    seed: int
+    ticks: int
+    groups: int = 4
+    peers: int = 3
+    group_cap: int = 24
+    total_cap: int = 48
+    offered_per_tick: int = 32      # ~2x the 4-group x 4-entry drain
+    hot_group: int = 0
+    hot_share: float = 0.5          # P(an offered write hits hot_group)
+    deadline_rate: float = 0.4      # P(a write carries a device-step
+    deadline_lo: int = 1            # deadline drawn in [lo, hi])
+    deadline_hi: int = 8
+    read_rate: float = 0.3
+    bursts: Tuple[OverloadBurst, ...] = ()
+    fsync_stalls: Tuple[FsyncStall, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    unsafe_no_admission: bool = False
+    # Acceptance floors (checked by chaos/run.py, not invariants):
+    # committed >= goodput_floor * ticks despite 2x offered load, and
+    # every group commits >= starvation_floor entries (no group is
+    # starved by the hot group's pressure).
+    goodput_floor: int = 2
+    starvation_floor: int = 8
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.describe(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def generate_overload(seed: int, ticks: int = 160) -> OverloadNemesisPlan:
+    """The overload nemesis family: sustained 2x offered load with two
+    burst windows (3x+), hot-group skew, two slow-fsync stall windows
+    (latency pressure, not failure), and one whole-cluster
+    crash+restart mid-overload so the durability audit replays WALs
+    written under admission pressure.  Admission is ON: the propose
+    backlog must never exceed total_cap, acked writes must survive the
+    restart, goodput must clear the floor and no group may starve —
+    and two runs must produce identical plan + result digests."""
+    rng = np.random.default_rng(seed ^ 0x10AD)
+    warmup = 30
+    b0 = int(rng.integers(warmup, warmup + ticks // 4))
+    b1 = int(rng.integers(ticks // 2, int(ticks * 0.7)))
+    bursts = (OverloadBurst(b0, b0 + int(rng.integers(10, 20)),
+                            int(rng.integers(16, 33))),
+              OverloadBurst(b1, b1 + int(rng.integers(10, 20)),
+                            int(rng.integers(16, 33))))
+    stalls = (FsyncStall(int(rng.integers(0, 3)),
+                         int(rng.integers(40, 80)), count=4,
+                         stall_s=0.01),
+              FsyncStall(int(rng.integers(0, 3)),
+                         int(rng.integers(120, 180)), count=4,
+                         stall_s=0.01))
+    crash = CrashEvent(int(rng.integers(int(ticks * 0.55),
+                                        int(ticks * 0.8))))
+    return OverloadNemesisPlan(
+        seed=seed, ticks=ticks, hot_group=int(rng.integers(0, 4)),
+        bursts=bursts, fsync_stalls=stalls, crashes=(crash,))
+
+
+def falsification_overload_plan(seed: int = 0,
+                                broken: bool = True
+                                ) -> OverloadNemesisPlan:
+    """DIRECTED unbounded-memory falsification: sustained 2x offered
+    load, no other faults.  broken=True attaches NO admission
+    controller (unsafe_no_admission): the open-loop producer outruns
+    the drain by ~16 entries/tick, so the propose backlog crosses
+    total_cap within a few ticks and the OVERLOAD-MEMORY invariant
+    MUST catch it.  The SAME schedule with the bounded controller
+    must pass — proving the harness detects exactly the missing
+    admission bound, not offered load in general."""
+    return OverloadNemesisPlan(
+        seed=seed, ticks=80, deadline_rate=0.0, read_rate=0.0,
+        unsafe_no_admission=broken,
+        goodput_floor=1, starvation_floor=1)

@@ -1,0 +1,39 @@
+"""Overload-control plane: admission, deadlines, brownout.
+
+Three coordinated defenses against offered load exceeding engine
+capacity (the serving-stack gap ISSUE 20 closes):
+
+  - admission control (admission.py OverloadController): bounded
+    per-group and per-engine propose budgets enforced at the hostplane
+    propose edge, refused with the typed `Overloaded` (HTTP 429 +
+    Retry-After, jittered from the observed drain rate);
+  - end-to-end deadlines: `X-Raft-Deadline-Ms` converted ONCE at the
+    serving edge into device-step units (the PR-9 lease-clock
+    discipline — never wall clock on digest-relevant paths) and
+    carried through ring record → RaftDB → hostplane staging, so
+    expired work is shed before WAL/fsync cost is paid;
+  - brownout ladder (admission.py BrownoutGovernor): under sustained
+    queue pressure linear reads degrade to lease-only, and — only for
+    clients opting in via `X-Raft-Brownout: allow` — to session
+    reads, never silently (X-Raft-Served-Mode names what was served).
+
+The plane is attachment-gated like the shm/replica/reshard planes: an
+engine without a controller attached (`node.overload is None`) runs
+the exact pre-existing code paths — `make chaos SEED=0` digests are
+pinned against that (bench_logs/chaos_digests.json).
+"""
+from raftsql_tpu.overload.admission import (BROWNOUT_LEASE_ONLY,
+                                            BROWNOUT_OFF,
+                                            BrownoutGovernor,
+                                            DeadlineExceeded,
+                                            OverloadController,
+                                            Overloaded,
+                                            deadline_steps,
+                                            retry_after_header,
+                                            retryable_refusal,
+                                            zero_metrics_doc)
+
+__all__ = ["Overloaded", "DeadlineExceeded", "OverloadController",
+           "BrownoutGovernor", "BROWNOUT_OFF", "BROWNOUT_LEASE_ONLY",
+           "deadline_steps", "retry_after_header", "retryable_refusal",
+           "zero_metrics_doc"]

@@ -1,0 +1,329 @@
+"""Admission control, deadline clocks, and the brownout governor.
+
+The controller is the single owner of every overload DECISION — the
+hostplane, ring, HTTP planes and replica tier only report observations
+(enqueues, drains, ticks) and obey the typed outcomes (`Overloaded`,
+`DeadlineExceeded`, a brownout-served read mode).  Decision functions
+are fail-closed (raftlint registry, analysis/config.py): every control
+path ends in an explicit return or raise, so "forgot the refusal
+branch" is a lint finding, not a melted queue.
+
+Determinism contract: decisions depend only on counters (queue depths,
+seeded jitter), never on wall clock — the overload chaos family
+digest-compares two runs bit for bit.  The only wall-derived quantity
+is the ADVISORY `Retry-After` value (drain-rate EWMA x tick interval),
+which is never digested.
+
+Units: deadlines travel as DEVICE STEPS (the PR-9 lease-clock
+discipline).  `deadline_steps()` converts the edge's `X-Raft-
+Deadline-Ms` budget exactly once; everything below the edge compares
+step counters.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+# Brownout ladder rungs (BrownoutGovernor.mode).
+BROWNOUT_OFF = 0          # serve everything normally
+BROWNOUT_LEASE_ONLY = 1   # linear reads: lease fast path only — no
+                          # ReadIndex rounds; degrade or refuse
+
+
+class Overloaded(Exception):
+    """Typed admission refusal: the caller must back off.
+
+    Surfaces as HTTP 429 + `Retry-After` on both HTTP planes, as
+    ST_OVERLOADED on the propose ring, and on the replica tier's
+    write-fallback path.  `retry_after_s` is advisory (jittered from
+    the observed queue drain rate); `scope` names which budget refused
+    ("group:<g>", "engine", "brownout", "ring", "replica")."""
+
+    def __init__(self, scope: str, retry_after_s: float,
+                 detail: str = ""):
+        super().__init__(
+            f"overloaded ({scope}): "
+            + (detail or "admission budget exhausted")
+            + f"; retry after {retry_after_s:.3f}s")
+        self.scope = scope
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(Exception):
+    """The request's end-to-end deadline passed before the work was
+    done — shed without paying the remaining cost.  `phase` names
+    where the shed happened (edge / ring / stage / commit_wait), the
+    per-phase attribution the /metrics overload section exports."""
+
+    def __init__(self, phase: str, detail: str = ""):
+        super().__init__(f"deadline exceeded at {phase}"
+                         + (f": {detail}" if detail else ""))
+        self.phase = phase
+
+
+def deadline_steps(now_step: int, deadline_ms: float,
+                   tick_interval_s: float) -> int:
+    """Convert an edge deadline budget (milliseconds remaining) into
+    an ABSOLUTE device-step deadline — the one wall→step conversion;
+    everything below the edge compares step counters (deterministic
+    under chaos replay).  Mirrors lease_deadline_s's floor: an untimed
+    engine (tick_interval_s == 0, step-per-loop) converts at 0.1 ms
+    per step."""
+    interval = max(float(tick_interval_s), 1e-4)
+    return int(now_step) + max(0, int((float(deadline_ms) / 1000.0)
+                                      / interval))
+
+
+def retry_after_header(retry_after_s: float) -> str:
+    """`Retry-After` wire value: decimal seconds (our client parses
+    float; integer-second RFC granularity is useless at millisecond
+    drain times), floored at 10 ms so a parsed 0 never means "hammer
+    immediately"."""
+    return f"{max(float(retry_after_s), 0.01):.3f}"
+
+
+def retryable_refusal(exc: Exception,
+                      default_retry_s: float = 1.0):
+    """THE unified retryable-refusal mapping, shared by both HTTP
+    planes (satellite: the threaded plane's ad-hoc 503s and the aio
+    plane must emit one consistent contract):
+
+        Overloaded        -> (429, its jittered retry_after_s)
+        anything else     -> (503, default_retry_s)
+
+    Returns (status_code, retry_after_s); the caller renders the
+    header via retry_after_header()."""
+    if isinstance(exc, Overloaded):
+        return 429, exc.retry_after_s
+    return 503, float(default_retry_s)
+
+
+class BrownoutGovernor:
+    """Hysteresis ladder over the queue-depth EWMA.
+
+    Enters BROWNOUT_LEASE_ONLY when the depth EWMA crosses `hi`,
+    exits at `lo` — the gap prevents mode flapping at the threshold.
+    The EWMA is fed once per engine tick (OverloadController
+    .note_tick), the same cadence as the PR-8 phase profiler whose
+    queue observations it summarizes."""
+
+    def __init__(self, hi: float, lo: float, alpha: float = 0.3):
+        if hi <= 0 or lo < 0 or lo >= hi:
+            raise ValueError("brownout thresholds need 0 <= lo < hi")
+        self.hi = float(hi)
+        self.lo = float(lo)
+        self.alpha = float(alpha)
+        self.ewma = 0.0
+        self.mode = BROWNOUT_OFF
+        self.transitions = 0
+
+    def note_depth(self, depth: int) -> int:
+        """Fold one tick's queue depth; returns the (possibly new)
+        mode."""
+        self.ewma += self.alpha * (float(depth) - self.ewma)
+        if self.mode == BROWNOUT_OFF and self.ewma > self.hi:
+            self.mode = BROWNOUT_LEASE_ONLY
+            self.transitions += 1
+        elif self.mode != BROWNOUT_OFF and self.ewma < self.lo:
+            self.mode = BROWNOUT_OFF
+            self.transitions += 1
+        return self.mode
+
+
+class OverloadController:
+    """Bounded propose budgets + per-phase deadline sheds + brownout.
+
+    Attachment contract (digest neutrality): an engine runs this plane
+    only when a controller is assigned to `node.overload`; the default
+    None keeps every hot path byte-identical to the pre-overload code.
+
+    Threading: admit/drained/shed_stage run under the hostplane's
+    `_prop_lock` (they are called from inside its critical sections);
+    note_tick runs on the tick thread; the edge counters are bumped
+    from HTTP threads GIL-atomically like every NodeMetrics counter.
+
+    `group_cap` bounds queued-but-unstaged entries per group,
+    `total_cap` per engine; 0 disables that budget (depth is still
+    tracked for the brownout governor and the queue_depth gauge)."""
+
+    def __init__(self, groups: int, group_cap: int = 0,
+                 total_cap: int = 0, seed: int = 0,
+                 tick_interval_s: float = 0.001,
+                 brownout_hi: Optional[float] = None,
+                 brownout_lo: Optional[float] = None):
+        self.groups = int(groups)
+        self.group_cap = int(group_cap)
+        self.total_cap = int(total_cap)
+        self.tick_interval_s = max(float(tick_interval_s), 1e-4)
+        self._rng = random.Random(seed)     # jitter only, never control
+        self._depth = [0] * self.groups
+        self.depth_total = 0
+        # Drain-rate EWMA (entries per tick): the Retry-After feed.
+        self._drain_ewma = 0.0
+        self._drained_since_tick = 0
+        # Counters (the six required /metrics leaves + per-phase shed).
+        self.admitted = 0
+        self.rejected = 0
+        self.shed_edge = 0
+        self.shed_ring = 0
+        self.shed_stage = 0
+        self.shed_commit_wait = 0
+        self.brownouts = 0                  # degraded/refused responses
+        self.peak_depth = 0
+        hi = brownout_hi if brownout_hi is not None else (
+            0.75 * self.total_cap if self.total_cap else float("inf"))
+        lo = brownout_lo if brownout_lo is not None else (
+            hi / 3.0 if hi != float("inf") else 0.0)
+        self.governor = BrownoutGovernor(hi, lo) \
+            if hi != float("inf") else None
+
+    # -- admission (under hostplane._prop_lock) ------------------------
+
+    # raftlint: fail-closed
+    def admit(self, group: int, n: int):
+        """Admit `n` entries into `group`'s propose queue or raise
+        Overloaded.  Budgets are checked BEFORE the enqueue, so actual
+        queue depth can never exceed the caps (the chaos memory-bound
+        invariant measures the real queues, not this bookkeeping)."""
+        g = int(group)
+        if self.group_cap and self._depth[g] + n > self.group_cap:
+            self.rejected += n
+            raise Overloaded(f"group:{g}", self.retry_after_s(),
+                             f"group queue at {self._depth[g]}"
+                             f"/{self.group_cap}")
+        if self.total_cap and self.depth_total + n > self.total_cap:
+            self.rejected += n
+            raise Overloaded("engine", self.retry_after_s(),
+                             f"engine queue at {self.depth_total}"
+                             f"/{self.total_cap}")
+        self._depth[g] += n
+        self.depth_total += n
+        self.admitted += n
+        if self.depth_total > self.peak_depth:
+            self.peak_depth = self.depth_total
+        return n
+
+    def drained(self, group: int, n: int) -> None:
+        """n entries left `group`'s queue toward the device (staged)."""
+        self._depth[int(group)] -= n
+        self.depth_total -= n
+        self._drained_since_tick += n
+
+    def stage_shed(self, group: int, n: int) -> None:
+        """n queued entries dropped at staging (expired deadline) —
+        the shed that saves WAL/fsync cost."""
+        self._depth[int(group)] -= n
+        self.depth_total -= n
+        self.shed_stage += n
+
+    def reset_depth(self) -> None:
+        """The propose queues died with their node (crash/restart):
+        re-zero depth bookkeeping; cumulative counters survive."""
+        self._depth = [0] * self.groups
+        self.depth_total = 0
+
+    # -- deadline sheds ------------------------------------------------
+
+    # raftlint: fail-closed
+    def check_deadline(self, now_step: int,
+                       deadline_step: Optional[int], phase: str):
+        """Shed work whose step deadline already passed; returns True
+        (still live) or raises DeadlineExceeded with the phase
+        attributed."""
+        if deadline_step is None:
+            return True
+        if int(now_step) <= int(deadline_step):
+            return True
+        self.note_shed(phase)
+        raise DeadlineExceeded(phase,
+                               f"step {int(now_step)} past "
+                               f"{int(deadline_step)}")
+
+    def note_shed(self, phase: str) -> None:
+        if phase == "edge":
+            self.shed_edge += 1
+        elif phase == "ring":
+            self.shed_ring += 1
+        elif phase == "stage":
+            self.shed_stage += 1
+        else:
+            self.shed_commit_wait += 1
+
+    # -- tick feed / brownout ------------------------------------------
+
+    def note_tick(self) -> None:
+        """Per-engine-tick observation: fold this tick's drain count
+        into the rate EWMA and feed the brownout governor the current
+        depth."""
+        d, self._drained_since_tick = self._drained_since_tick, 0
+        self._drain_ewma += 0.3 * (float(d) - self._drain_ewma)
+        if self.governor is not None:
+            self.governor.note_depth(self.depth_total)
+
+    def brownout_active(self) -> bool:
+        return (self.governor is not None
+                and self.governor.mode != BROWNOUT_OFF)
+
+    # raftlint: fail-closed
+    def brownout_read_path(self, opt_in: bool):
+        """Decide how a linear read proceeds when the lease fast path
+        is unavailable: outside brownout pay the ReadIndex round
+        ("read_index"); inside it, degrade to "session" for clients
+        that opted in (X-Raft-Brownout: allow) or refuse typed —
+        NEVER a silent stale answer."""
+        if not self.brownout_active():
+            return "read_index"
+        self.brownouts += 1
+        if opt_in:
+            return "session"
+        raise Overloaded(
+            "brownout", self.retry_after_s(),
+            "linear reads are lease-only under brownout (send "
+            "X-Raft-Brownout: allow to accept a session read)")
+
+    # -- advisory backoff ----------------------------------------------
+
+    def retry_after_s(self) -> float:
+        """Jittered advisory backoff: the time the CURRENT backlog
+        needs to drain at the observed rate, x [0.5, 1.5) jitter so a
+        refused client herd does not re-arrive in phase.  Clamped to
+        [10 ms, 5 s]; with no drain observed yet, the pessimistic
+        clamp ceiling applies."""
+        rate = self._drain_ewma                     # entries / tick
+        if rate <= 1e-6:
+            base = 5.0
+        else:
+            base = (max(self.depth_total, 1) / rate) \
+                * self.tick_interval_s
+        base = min(max(base, 0.01), 5.0)
+        return base * (0.5 + self._rng.random())
+
+    # -- export --------------------------------------------------------
+
+    def metrics_doc(self) -> dict:
+        doc = {
+            "admitted": int(self.admitted),
+            "rejected": int(self.rejected),
+            "shed_edge": int(self.shed_edge),
+            "shed_ring": int(self.shed_ring),
+            "shed_stage": int(self.shed_stage),
+            "shed_commit_wait": int(self.shed_commit_wait),
+            "brownouts": int(self.brownouts),
+            "queue_depth": int(self.depth_total),
+            "queue_depth_peak": int(self.peak_depth),
+            "group_cap": int(self.group_cap),
+            "total_cap": int(self.total_cap),
+            "brownout_active": int(self.brownout_active()),
+        }
+        return doc
+
+
+def zero_metrics_doc() -> dict:
+    """The overload /metrics section when no controller is attached —
+    zeros so the raftsql_overload_* series exist from boot on every
+    deployment (scripts/check_prom.py requires them), mirroring the
+    replica section's precedent."""
+    return {"admitted": 0, "rejected": 0, "shed_edge": 0,
+            "shed_ring": 0, "shed_stage": 0, "shed_commit_wait": 0,
+            "brownouts": 0, "queue_depth": 0, "queue_depth_peak": 0,
+            "group_cap": 0, "total_cap": 0, "brownout_active": 0}

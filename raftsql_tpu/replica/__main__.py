@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     ap.add_argument("--unsafe-serve", action="store_true",
                     help="DANGEROUS: disable the session/linear "
                          "fail-closed gates (chaos falsification only)")
+    ap.add_argument("--write-cap", type=int, default=0,
+                    help="bound on concurrent write-fallback redirects "
+                         "(excess answers 429 + Retry-After; 0 = "
+                         "unbounded)")
     args = ap.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -52,7 +56,8 @@ def main(argv=None) -> int:
     sub = ReplicaSubscriber(parse_hostport(args.upstream),
                             advertise=advertise)
     sub.start()
-    rdb = ReplicaDB(sub, unsafe_serve=args.unsafe_serve)
+    rdb = ReplicaDB(sub, unsafe_serve=args.unsafe_serve,
+                    write_cap=args.write_cap)
     if args.unsafe_serve:
         log.warning("UNSAFE-SERVE: session/linear gates disabled — "
                     "chaos falsification mode, never production")

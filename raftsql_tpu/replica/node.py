@@ -57,6 +57,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine, is_select
+from raftsql_tpu.overload import Overloaded, zero_metrics_doc
 from raftsql_tpu.replica import stream as wire
 from raftsql_tpu.runtime.db import NotLeaderError
 from raftsql_tpu.runtime.shm import KIND_BASE, KIND_DELTA, PUB_STALE_NS
@@ -290,7 +291,8 @@ class ReplicaDB:
     serve it unchanged.  Reads run the fail-closed ladder; every write
     or admin verb refuses 421 toward the authoritative tier."""
 
-    def __init__(self, sub: ReplicaSubscriber, unsafe_serve: bool = False):
+    def __init__(self, sub: ReplicaSubscriber, unsafe_serve: bool = False,
+                 write_cap: int = 0):
         self.sub = sub
         self.unsafe_serve = unsafe_serve
         self.reshard = None          # /kv and POST /reshard answer 503
@@ -298,6 +300,13 @@ class ReplicaDB:
         self._mu = threading.Lock()
         self.hits = {m: 0 for m in _MODES}      # raftlint: guarded-by=_mu
         self.refusals: Dict[str, int] = {}      # raftlint: guarded-by=_mu
+        # Write-fallback admission: the redirect lookup contends the
+        # fold lock, so a misdirected-write stampede must be shed (429
+        # + Retry-After) before it queues unboundedly on _cond and
+        # starves the subscriber.  0 = unbounded (seed behaviour).
+        self.write_cap = int(write_cap)
+        self._write_inflight = 0                # raftlint: guarded-by=_mu
+        self._overloaded = 0                    # raftlint: guarded-by=_mu
         self._closed = False
 
     @property
@@ -314,12 +323,24 @@ class ReplicaDB:
     # raftlint: fail-closed
     def query(self, query: str, group: int = 0, linear: bool = False,
               timeout: float = 10.0, mode: Optional[str] = None,
-              watermark: int = 0) -> str:
+              watermark: int = 0,
+              deadline_ms: Optional[float] = None, brownout: bool = False,
+              info: Optional[dict] = None) -> str:
         if not is_select(query):
             raise ValueError("replica tier is read-only (expected SELECT)")
         mode = (mode or ("linear" if linear else "local")).lower()
         if mode not in _MODES:
             raise ValueError(f"unknown consistency mode {mode!r}")
+        if deadline_ms is not None:
+            # The caller's end-to-end budget caps every gate wait; the
+            # plane already shed <=0 at the edge.
+            timeout = min(float(timeout or GATE_WAIT_S),
+                          max(deadline_ms / 1000.0, 0.0))
+        if info is not None:
+            # The replica never silently downgrades: the mode asked for
+            # is the mode served (a failed gate refuses instead), so the
+            # served-mode contract header is just the request mode.
+            info["served"] = mode
         sub = self.sub
         bound = max(0.01, min(float(timeout or GATE_WAIT_S), GATE_WAIT_S))
         with sub._cond:
@@ -382,23 +403,51 @@ class ReplicaDB:
 
     # -- the write/admin surface: refuse toward the write tier -----------
 
+    # raftlint: fail-closed
+    def _admit_write(self) -> None:
+        """Bounded budget on the write-fallback path: each refusal
+        still takes the fold lock for the leader hint, so a stampede
+        of misdirected writes is shed with a typed Overloaded (the
+        planes answer 429 + Retry-After) once `write_cap` lookups are
+        already in flight, rather than queueing without bound."""
+        with self._mu:
+            if self.write_cap > 0 and self._write_inflight >= self.write_cap:
+                self.refusals["overloaded"] = \
+                    self.refusals.get("overloaded", 0) + 1
+                self._overloaded += 1
+                raise Overloaded(
+                    "replica",
+                    min(0.05 * (1 + self._write_inflight), 5.0),
+                    "write-fallback budget exhausted")
+            self._write_inflight += 1
+            return None
+
+    def _leader_hint(self, group: int) -> int:
+        # Admission precedes the try: on refusal nothing was admitted,
+        # so only a successful admit reaches the decrement.
+        self._admit_write()
+        try:
+            with self.sub._cond:
+                return self.sub.leader_locked(group)
+        finally:
+            with self._mu:
+                self._write_inflight -= 1
+
     def propose(self, query: str, group: int = 0,
-                token: Optional[int] = None):
-        with self.sub._cond:
-            leader = self.sub.leader_locked(group)
+                token: Optional[int] = None,
+                deadline_ms: Optional[float] = None):
+        leader = self._leader_hint(group)
         self._refuse(group, leader, "read-only-tier")
 
     def abandon(self, query: str, group: int, fut) -> None:
         pass                         # nothing in flight, ever
 
     def member_change(self, group: int, *a, **k):
-        with self.sub._cond:
-            leader = self.sub.leader_locked(group)
+        leader = self._leader_hint(group)
         self._refuse(group, leader, "read-only-tier")
 
     def transfer(self, group: int, *a, **k):
-        with self.sub._cond:
-            leader = self.sub.leader_locked(group)
+        leader = self._leader_hint(group)
         self._refuse(group, leader, "read-only-tier")
 
     # -- observability ---------------------------------------------------
@@ -470,6 +519,13 @@ class ReplicaDB:
                     "connects": int(sub.connects),
                 },
             }
+        # Same overload section the engine exports (zeros-by-default);
+        # only the write-fallback budget is live on this tier.
+        ov = zero_metrics_doc()
+        with self._mu:
+            ov["rejected"] = int(self._overloaded)
+            ov["total_cap"] = int(self.write_cap)
+        m["overload"] = ov
         return m
 
     def members(self) -> dict:

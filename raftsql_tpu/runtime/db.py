@@ -37,6 +37,8 @@ from typing import Callable, Dict, Optional, Tuple
 
 from raftsql_tpu.models.base import StateMachine
 from raftsql_tpu.models.sqlite_sm import is_select
+from raftsql_tpu.overload import (Overloaded, deadline_steps,
+                                  zero_metrics_doc)
 from raftsql_tpu.runtime.envelope import unwrap
 from raftsql_tpu.transport.codec import is_conf_entry
 from raftsql_tpu.runtime.node import (CLOSED, RAW_BATCH, RAW_MANY,
@@ -515,7 +517,8 @@ class RaftDB:
         self.pipe.node.compact(applied, keep=self._compact_keep)
 
     def propose(self, query: str, group: int = 0,
-                token: Optional[int] = None) -> AckFuture:
+                token: Optional[int] = None,
+                deadline_ms: Optional[float] = None) -> AckFuture:
         """Submit a write; the future resolves after commit + local apply
         (the reference's blocking-PUT contract, httpapi.go:45-49).
 
@@ -524,7 +527,15 @@ class RaftDB:
         PUT — after a timeout, a dropped connection, or a crashed
         leader — passes the same token and the publish-time dedup
         window applies whichever copies commit exactly once (the
-        duplicate's commit still ACKS, it just doesn't re-apply)."""
+        duplicate's commit still ACKS, it just doesn't re-apply).
+
+        `deadline_ms` (remaining client budget, X-Raft-Deadline-Ms) is
+        converted ONCE here from wall budget to a device-step deadline
+        (raftsql_tpu/overload/ discipline) and carried with the queue
+        entry, so work already expired at staging time is shed before
+        WAL/fsync cost is paid.  Raises `Overloaded` (HTTP 429) when an
+        attached admission controller refuses the enqueue; no-op when
+        no overload plane is attached."""
         fut = AckFuture()
         if is_select(query):
             fut.set(ValueError("expected non-SELECT"))
@@ -533,6 +544,12 @@ class RaftDB:
             fut.set(ValueError(f"group {group} out of range "
                                f"[0, {self.num_groups})"))
             return fut
+        node = self.pipe.node
+        dstep = None
+        if deadline_ms is not None \
+                and getattr(node, "overload", None) is not None:
+            dstep = deadline_steps(node._device_steps, deadline_ms,
+                                   node.cfg.tick_interval_s)
         with self._mu:
             if self._failed is not None:
                 fut.set(self._failed)
@@ -541,7 +558,17 @@ class RaftDB:
                 fut.set(RuntimeError("db is closed"))
                 return fut
             self._q2cb[(group, query)].append(fut)
-        self.pipe.propose(group, query.encode("utf-8"), token)
+        try:
+            if dstep is not None:
+                self.pipe.propose(group, query.encode("utf-8"), token,
+                                  deadline_step=dstep)
+            else:
+                self.pipe.propose(group, query.encode("utf-8"), token)
+        except Overloaded:
+            # Refused at the admission edge: nothing was enqueued, so
+            # the ack callback must not linger in _q2cb.
+            self.abandon(query, group, fut)
+            raise
         return fut
 
     def abandon(self, query: str, group: int, fut: AckFuture) -> None:
@@ -591,7 +618,10 @@ class RaftDB:
 
     def query(self, query: str, group: int = 0,
               linear: bool = False, timeout: float = 10.0,
-              mode: Optional[str] = None, watermark: int = 0) -> str:
+              mode: Optional[str] = None, watermark: int = 0,
+              deadline_ms: Optional[float] = None,
+              brownout: bool = False,
+              info: Optional[dict] = None) -> str:
         """Read path, five consistency modes (README read-modes table):
 
           - "local" (default): the reference's stale local read —
@@ -630,7 +660,13 @@ class RaftDB:
         node = self.pipe.node
         m = getattr(node, "metrics", None)
         tick = node.cfg.tick_interval_s or 0.001
+        if deadline_ms is not None:
+            # The client's end-to-end budget bounds every wait below;
+            # a tighter server-side timeout still wins.
+            timeout = min(timeout, max(float(deadline_ms) / 1000.0, 0.0))
         deadline = time.monotonic() + timeout
+        if info is not None:
+            info["served"] = mode
         if mode == "local":
             if m is not None:
                 m.reads_local += 1
@@ -648,15 +684,26 @@ class RaftDB:
                 else max(watermark, 0)
             self._wait_applied(group, target, deadline, tick, "follower")
         elif mode == "linear":
-            self._linear_wait(node, group, deadline, tick)
+            self._linear_wait(node, group, deadline, tick,
+                              brownout=brownout, info=info)
         else:
             raise ValueError(f"unknown read mode {mode!r}")
         return self._sms[group].query(query)
 
     def _linear_wait(self, node, group: int, deadline: float,
-                     tick: float) -> None:
+                     tick: float, brownout: bool = False,
+                     info: Optional[dict] = None) -> None:
         """The linearizable read protocol: lease fast path, then the
-        ReadIndex round, each wait bounded by `deadline`."""
+        ReadIndex round, each wait bounded by `deadline`.
+
+        Brownout ladder (raftsql_tpu/overload/): when an attached
+        governor reports sustained queue pressure, the ReadIndex
+        fallback is withheld — the lease fast path still serves full
+        linearizability for free, but a lease miss refuses (429) unless
+        the client opted in via `brownout=True` (X-Raft-Brownout:
+        allow), in which case the read degrades to a session read at
+        this replica's current applied point and `info["served"]`
+        names the mode actually served.  Never a silent downgrade."""
         m = getattr(node, "metrics", None)
         lease_fn = getattr(node, "lease_read", None)
         lease_on = node.cfg.lease_ticks > 0 and lease_fn is not None
@@ -672,6 +719,15 @@ class RaftDB:
             # pending): degrade to the full quorum round.
             if m is not None:
                 m.lease_degrades += 1
+        ov = getattr(node, "overload", None)
+        if ov is not None:
+            path = ov.brownout_read_path(brownout)  # may raise Overloaded
+            if path == "session":
+                # Opted-in degradation: serve at whatever this replica
+                # has applied, skipping the quorum round entirely.
+                if info is not None:
+                    info["served"] = "session"
+                return
         if m is not None:
             m.reads_read_index += 1
         join_fn = getattr(node, "read_join", None)
@@ -804,6 +860,14 @@ class RaftDB:
             m["replica"] = {"subscribers": 0, "deltas_tx": 0,
                             "bases_tx": 0, "resyncs": 0,
                             "refusals": 0, "lag_ms": 0}
+        # Overload plane (raftsql_tpu/overload/): admission, per-phase
+        # shed, and brownout counters when a controller is attached;
+        # zeros otherwise so the raftsql_overload_* series exist from
+        # boot on every deployment (scripts/check_prom.py requires
+        # them), same contract as the replica section above.
+        ovc = getattr(node, "overload", None)
+        m["overload"] = (ovc.metrics_doc() if ovc is not None
+                         else zero_metrics_doc())
         gcw = getattr(node, "_gcwal", None)
         if gcw is not None:
             # Group-commit batch histogram: peers coalesced per fsync

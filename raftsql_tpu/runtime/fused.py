@@ -108,12 +108,16 @@ class FusedPipe:
         self.commit_q = node.commit_q(0)
 
     def propose(self, group: int, payload: bytes,
-                pid: Optional[int] = None) -> None:
+                pid: Optional[int] = None,
+                deadline_step: Optional[int] = None) -> None:
         # `pid` (client retry token) is accepted for facade parity and
         # dropped: fused proposals are routed on the host and never
         # forward-retried, so payloads travel PLAIN (no envelope to
         # carry the token — see runtime/db.py RAW_PLAIN contract).
-        self.node.propose_many(group, [payload])
+        # `deadline_step` (overload plane, device-step units) rides to
+        # the hostplane so expired work is shed before staging.
+        self.node.propose_many(group, [payload],
+                               deadline_step=deadline_step)
 
     @property
     def error(self) -> Optional[Exception]:

@@ -249,6 +249,17 @@ class ClusterHostPlane:
         # inside one C call per publish instead of being materialized as
         # Python bytes for a queue consumer.
         self.native_kv = None
+        # Overload-control plane (raftsql_tpu/overload/), attachment-
+        # gated like tracer/membership: None keeps propose_many and the
+        # staging path byte-identical to the pre-overload code (the
+        # chaos digest-neutrality pin).  When attached, propose_many
+        # charges its budgets under _prop_lock and the staging path
+        # sheds expired-deadline entries before any WAL cost.
+        self.overload = None
+        # True once any deadline-carrying proposal entered the queues:
+        # only then does staging pay the per-entry deadline strip
+        # (queue entries become (payload, deadline_step) pairs).
+        self._deadlines_live = False  # raftlint: guarded-by=_prop_lock
         # Observability (raftsql_tpu/obs/, OFF by default): a host-plane
         # span tracer and the on-device event ring.  Every hook below is
         # gated on these being non-None, so the disabled tick pays one
@@ -839,18 +850,32 @@ class ClusterHostPlane:
             recent = list(self._xfer_events)
         return {"in_flight": inflight, "recent": recent}
 
-    def propose_many(self, group: int, payloads) -> None:
+    def propose_many(self, group: int, payloads,
+                     deadline_step: Optional[int] = None) -> None:
         """Queue payloads at the group's current leader peer (host-side
         routing — all peers share this process; the distributed
-        runtime's forward-over-transport becomes a list move)."""
+        runtime's forward-over-transport becomes a list move).
+
+        `deadline_step` (absolute device-step deadline, overload plane
+        only) rides each entry as a (payload, deadline) pair; staging
+        strips it and sheds entries already past it BEFORE any WAL
+        cost.  With no overload controller attached and no deadline,
+        this path is byte-identical to the pre-overload code."""
         if self.tracer is not None:
             for d in payloads:
                 self.tracer.begin(group,
                                   d.decode("utf-8", "replace"))
+        ov = self.overload
+        if deadline_step is not None:
+            payloads = [(d, int(deadline_step)) for d in payloads]
         p = int(self._hints[group])
         if p < 0:
             p = 0
         with self._prop_lock:
+            if ov is not None:
+                ov.admit(group, len(payloads))   # raises Overloaded
+            if deadline_step is not None:
+                self._deadlines_live = True
             self._props[p][group].extend(payloads)
             self._queued.add((p, group))
         self._work_evt.set()
@@ -1008,6 +1033,8 @@ class ClusterHostPlane:
         cap = self._E * steps
         prop_n = np.zeros((P, G), np.int32)
         dead = []
+        ov = self.overload
+        now_step = self._device_steps
         with self._prop_lock:
             for (p, g) in list(self._queued):  # snapshot: re-routes mutate
                 q = self._props[p][g]
@@ -1022,6 +1049,23 @@ class ClusterHostPlane:
                     self._queued.add((h, g))
                     dead.append((p, g))
                     continue
+                if self._deadlines_live:
+                    # Shed queued entries whose device-step deadline
+                    # already passed — BEFORE they are offered to the
+                    # device, so no WAL write, fsync or publish is ever
+                    # paid for work the client has given up on
+                    # (overload plane; entries are (payload, deadline)
+                    # pairs only when a deadline was supplied).
+                    live = [e for e in q
+                            if type(e) is not tuple or e[1] >= now_step]
+                    n_shed = len(q) - len(live)
+                    if n_shed:
+                        q[:] = live
+                        if ov is not None:
+                            ov.stage_shed(g, n_shed)
+                        if not q:
+                            dead.append((p, g))
+                            continue
                 prop_n[p, g] = min(len(q), cap)
             for k in dead:
                 self._queued.discard(k)
@@ -1274,6 +1318,10 @@ class ClusterHostPlane:
         if prof_on:
             prof.record("pop", self._tick_no, ts0,
                         _t.monotonic() - ts0)
+        if self.overload is not None:
+            # Overload plane tick feed: drain-rate EWMA (Retry-After)
+            # + queue-depth EWMA (the brownout governor's hysteresis).
+            self.overload.note_tick()
         # Content-derived activity signals (durable-independent so the
         # stash decision cannot change them): any append staged or
         # mirrored, or any hard state due to change.
@@ -1491,6 +1539,8 @@ class ClusterHostPlane:
                 props_p = self._props[p]
                 traced = [] if self.tracer is not None else None
                 confs = [] if self.membership is not None else None
+                ov = self.overload
+                strip = self._deadlines_live
                 with self._prop_lock:   # pops race client-thread extends
                     for g, n, b0, tm in zip(ags.tolist(),
                                             acc[ags].tolist(),
@@ -1499,6 +1549,14 @@ class ClusterHostPlane:
                         q = props_p[g]
                         batch = q[:n]
                         del q[:n]
+                        if strip:
+                            # Deadline-carrying entries are (payload,
+                            # deadline_step) pairs — strip to plain
+                            # bytes before WAL/trace/conf consumers.
+                            batch = [e[0] if type(e) is tuple else e
+                                     for e in batch]
+                        if ov is not None:
+                            ov.drained(g, n)
                         w_d.extend(batch)
                         r_g.append(g)
                         r_start.append(b0)

@@ -40,7 +40,7 @@ Record grammar (little endian; shared by RingClient/RingServer only —
 nothing else parses these):
 
   request:    u8 op | u64 req_id | u32 group | u8 flags | u64 token
-              | bytes body
+              | u64 deadline | bytes body
       op 1 PUT      body = sql          (token: X-Raft-Retry-Token, 0 none)
       op 2 GET      body = sql          (flags bit 0: linearizable,
                                          bit 1: session, bit 2: follower;
@@ -48,6 +48,10 @@ nothing else parses these):
       op 3 DOC      body = document name (metrics/health/members/...)
       op 4 MEMBER   body = json {group, op, peer}
       op 5 XFER     body = json {group, target} (leadership transfer)
+      deadline: absolute CLOCK_MONOTONIC milliseconds after which the
+      request is dead (0 = none).  Rings are same-machine mmaps, so the
+      monotonic clock is shared; the engine sheds expired records at the
+      drain (counted shed_ring) before any WAL/fsync cost.
   completion: u64 req_id | u8 status | u32 leader | bytes body
       status 0 OK   (body = rows/doc for GET/DOC/MEMBER, empty for PUT;
                      leader = the engine's session watermark for the
@@ -55,6 +59,10 @@ nothing else parses these):
       status 1 ERR  (body = message; deterministic 400 class)
       status 2 NOT_LEADER (leader = 1-based hint; 421 class)
       status 3 UNAVAILABLE (body = message; 503 class)
+      status 4 OVERLOADED (body = message; 429 class — admission
+                     refusal or ring-drain deadline shed; leader =
+                     Retry-After in MILLISECONDS, the controller's
+                     jittered drain-rate estimate)
 """
 from __future__ import annotations
 
@@ -74,11 +82,12 @@ _HDR = 64                             # file header bytes
 _OFF_MAGIC, _OFF_CAP, _OFF_HEAD, _OFF_TAIL = 0, 4, 16, 32
 _WRAP = 0xFFFFFFFF
 
-_REQ = struct.Struct("<BQIBQ")        # op, req_id, group, flags, token
+_REQ = struct.Struct("<BQIBQQ")       # op, req_id, group, flags, token,
+#                                       deadline (monotonic ms, 0 none)
 _CPL = struct.Struct("<QBI")          # req_id, status, leader
 
 OP_PUT, OP_GET, OP_DOC, OP_MEMBER, OP_XFER, OP_RESHARD = 1, 2, 3, 4, 5, 6
-ST_OK, ST_ERR, ST_NOT_LEADER, ST_UNAVAILABLE = 0, 1, 2, 3
+ST_OK, ST_ERR, ST_NOT_LEADER, ST_UNAVAILABLE, ST_OVERLOADED = 0, 1, 2, 3, 4
 
 DEFAULT_RING_BYTES = 4 << 20
 
@@ -264,13 +273,17 @@ class SpscRing:
 
 
 def encode_request(op: int, req_id: int, group: int, flags: int,
-                   token: int, body: bytes) -> bytes:
-    return _REQ.pack(op, req_id, group, flags, token) + body
+                   token: int, body: bytes,
+                   deadline_mono_ms: int = 0) -> bytes:
+    return _REQ.pack(op, req_id, group, flags, token,
+                     deadline_mono_ms) + body
 
 
-def decode_request(view) -> Tuple[int, int, int, int, int, bytes]:
-    op, req_id, group, flags, token = _REQ.unpack_from(view, 0)
-    return op, req_id, group, flags, token, bytes(view[_REQ.size:])
+def decode_request(view) -> Tuple[int, int, int, int, int, int, bytes]:
+    op, req_id, group, flags, token, deadline = \
+        _REQ.unpack_from(view, 0)
+    return op, req_id, group, flags, token, deadline, \
+        bytes(view[_REQ.size:])
 
 
 def encode_completion(req_id: int, status: int, leader: int,
@@ -474,6 +487,21 @@ class RingServer:
     def _err_body(self, e: BaseException) -> bytes:
         return str(e).encode("utf-8", "replace")[:4096]
 
+    def _overload(self):
+        """The engine's attached admission controller, or None — the
+        same attachment point the HTTP planes consult
+        (node.overload, raftsql_tpu/overload/)."""
+        return getattr(getattr(getattr(self.rdb, "pipe", None),
+                               "node", None), "overload", None)
+
+    def _retry_after_ms(self) -> int:
+        """Retry-After for an ST_OVERLOADED completion's leader field
+        (milliseconds, clamped to the wire's u32)."""
+        ov = self._overload()
+        if ov is None:
+            return 1000
+        return min(int(ov.retry_after_s() * 1000), 0xFFFFFFFF)
+
     # -- request handlers -----------------------------------------------
 
     def _watermark(self, group: int) -> int:
@@ -485,7 +513,8 @@ class RingServer:
             return 0
 
     def _handle_put(self, worker: int, req_id: int, group: int,
-                    token: int, body: bytes) -> None:
+                    token: int, body: bytes,
+                    deadline_ms: Optional[float] = None) -> None:
         entry = None
         if token:
             with self._tok_mu:
@@ -512,8 +541,27 @@ class RingServer:
                 return
         try:
             fut = self.rdb.propose(body.decode("utf-8"), group,
-                                   token=token or None)
+                                   token=token or None,
+                                   **({} if deadline_ms is None
+                                      else {"deadline_ms": deadline_ms}))
         except Exception as e:                          # noqa: BLE001
+            from raftsql_tpu.overload import Overloaded
+            if isinstance(e, Overloaded):
+                # Admission refusal: 429 class — Retry-After rides the
+                # completion's leader field (milliseconds).  Drop the
+                # token entry (nothing is in flight), so a backed-off
+                # retry re-proposes fresh instead of joining a waiter
+                # list nothing will ever resolve.
+                waiters = [(worker, req_id)]
+                if entry is not None:
+                    with self._tok_mu:
+                        self._tokens.pop(token, None)
+                        waiters = entry[2]
+                ra = min(int(e.retry_after_s * 1000), 0xFFFFFFFF)
+                for (w, rid) in waiters:
+                    self._complete(w, rid, ST_OVERLOADED, ra,
+                                   self._err_body(e))
+                return
             self._resolve_put(entry, worker, req_id, self._err_body(e),
                               0)
             return
@@ -548,7 +596,9 @@ class RingServer:
                 self._complete(w, rid, ST_ERR, 0, err_body)
 
     def _handle_get(self, worker: int, req_id: int, group: int,
-                    flags: int, token: int, body: bytes) -> None:
+                    flags: int, token: int, body: bytes,
+                    deadline_ms: Optional[float] = None) -> None:
+        from raftsql_tpu.overload import Overloaded
         from raftsql_tpu.runtime.db import NotLeaderError
         # Flags bit 0 = linear, bit 1 = session (token carries the
         # watermark), bit 2 = follower; no bit = stale local read.
@@ -558,9 +608,19 @@ class RingServer:
 
         def _run():
             try:
-                rows = self.rdb.query(body.decode("utf-8"), group,
-                                      mode=mode, watermark=token,
-                                      timeout=self.timeout_s)
+                rows = self.rdb.query(
+                    body.decode("utf-8"), group, mode=mode,
+                    watermark=token, timeout=self.timeout_s,
+                    **({} if deadline_ms is None
+                       else {"deadline_ms": deadline_ms}))
+            except Overloaded as e:
+                # Brownout refusal at the engine: over the ring the
+                # opt-in downgrade is NOT offered (the completion has
+                # no served-mode channel and a silent downgrade is
+                # forbidden) — 429 + Retry-After, the client backs off.
+                self._complete(worker, req_id, ST_OVERLOADED,
+                               min(int(e.retry_after_s * 1000),
+                                   0xFFFFFFFF), self._err_body(e))
             except NotLeaderError as e:
                 self._complete(worker, req_id, ST_NOT_LEADER,
                                max(e.leader, 0), self._err_body(e))
@@ -684,17 +744,33 @@ class RingServer:
                 view = ring.pop()
                 if view is None:
                     break
-                op, req_id, group, flags, token, body = \
+                op, req_id, group, flags, token, wire_dl, body = \
                     decode_request(view)
                 ring.pop_commit()       # bytes copied out; release early
                 worked = True
+                # Ring-phase deadline shed (overload plane): a record
+                # whose absolute monotonic-ms deadline already passed
+                # while queued does no consensus work — ST_OVERLOADED
+                # before any WAL/fsync cost, counted shed_ring.
+                deadline_ms = None
+                if wire_dl:
+                    remain = wire_dl - time.monotonic() * 1000.0
+                    if remain <= 0:
+                        ov = self._overload()
+                        if ov is not None:
+                            ov.note_shed("ring")
+                        self._complete(worker, req_id, ST_OVERLOADED,
+                                       self._retry_after_ms(),
+                                       b"deadline exceeded (ring)")
+                        continue
+                    deadline_ms = remain
                 try:
                     if op == OP_PUT:
                         self._handle_put(worker, req_id, group, token,
-                                         body)
+                                         body, deadline_ms)
                     elif op == OP_GET:
                         self._handle_get(worker, req_id, group, flags,
-                                         token, body)
+                                         token, body, deadline_ms)
                     elif op == OP_DOC:
                         self._handle_doc(worker, req_id, body)
                     elif op == OP_MEMBER:
@@ -809,7 +885,19 @@ class RingClient:
                  OP_XFER: "ring.transfer", OP_RESHARD: "ring.reshard"}
 
     def _submit(self, op: int, group: int, flags: int, token: int,
-                body: bytes, deadline_s: float = 2.0) -> "RingFuture":
+                body: bytes, deadline_s: Optional[float] = None,
+                deadline_ms: Optional[float] = None) -> "RingFuture":
+        """`deadline_s` bounds the ring-full backoff below — callers
+        plumb their own timeout through (member/transfer/doc pass
+        their wait budgets, query passes its `timeout`) instead of the
+        old hardcoded 2 s, so worker-side timeouts and engine-side
+        deadlines agree.  `deadline_ms` (remaining client budget)
+        additionally rides the record as an absolute monotonic-ms
+        deadline the engine sheds against."""
+        if deadline_s is None:
+            deadline_s = 2.0
+        wire_dl = 0 if deadline_ms is None else \
+            max(1, int(time.monotonic() * 1000.0 + deadline_ms))
         fut = RingFuture()
         with self._mu:
             req_id = self._next_id
@@ -823,7 +911,7 @@ class RingClient:
                 self._t0s[req_id] = (time.monotonic(),
                                      self._OP_NAMES.get(op, "ring.op"))
             ok = self._req.push(encode_request(op, req_id, group, flags,
-                                               token, body))
+                                               token, body, wire_dl))
         if not ok:
             # Ring full: back off briefly — the engine drains in big
             # gulps, so a full ring clears in microseconds unless the
@@ -833,7 +921,7 @@ class RingClient:
                 time.sleep(0.0002)
                 with self._mu:
                     ok = self._req.push(encode_request(
-                        op, req_id, group, flags, token, body))
+                        op, req_id, group, flags, token, body, wire_dl))
                     if not ok and time.monotonic() > deadline:
                         self._pending.pop(req_id, None)
                         raise RingFull("propose ring full "
@@ -890,9 +978,17 @@ class RingClient:
     # -- the RaftDB surface ---------------------------------------------
 
     def propose(self, query: str, group: int = 0,
-                token: Optional[int] = None) -> "RingFuture":
-        return self._submit(OP_PUT, group, 0, token or 0,
-                            query.encode("utf-8"))
+                token: Optional[int] = None,
+                deadline_ms: Optional[float] = None) -> "RingFuture":
+        """`deadline_ms` (the client's remaining X-Raft-Deadline-Ms
+        budget) rides the ring record so the engine sheds expired
+        proposals before staging, and bounds the ring-full backoff so
+        the worker never outwaits its own client."""
+        return self._submit(
+            OP_PUT, group, 0, token or 0, query.encode("utf-8"),
+            deadline_s=(None if deadline_ms is None
+                        else max(deadline_ms / 1000.0, 0.001)),
+            deadline_ms=deadline_ms)
 
     def abandon(self, query: str, group: int, fut) -> None:
         """Deregister a timed-out proposal's callback (parity with
@@ -914,8 +1010,23 @@ class RingClient:
 
     def query(self, query: str, group: int = 0, linear: bool = False,
               timeout: float = 10.0, mode: Optional[str] = None,
-              watermark: int = 0) -> str:
+              watermark: int = 0, deadline_ms: Optional[float] = None,
+              brownout: bool = False,
+              info: Optional[dict] = None) -> str:
+        """`deadline_ms` bounds the wait AND rides the ring record so
+        the engine sheds the read once expired.  `brownout` (the
+        client's X-Raft-Brownout opt-in) is accepted for facade parity
+        but NOT forwarded: the completion wire has no served-mode
+        channel and the overload contract forbids a silent downgrade,
+        so a browned-out lease miss surfaces as Overloaded (429) here
+        and the client backs off or retries another node."""
+        from raftsql_tpu.overload import Overloaded
         from raftsql_tpu.runtime.db import NotLeaderError
+        if deadline_ms is not None:
+            timeout = min(timeout, max(deadline_ms / 1000.0, 0.0))
+        if info is not None:
+            info["served"] = mode if mode is not None else \
+                ("linear" if linear else "local")
         if mode is None:
             mode = "linear" if linear else "local"
         flags = {"local": 0, "linear": 1, "session": 2,
@@ -944,7 +1055,8 @@ class RingClient:
             self._shm_fallbacks += 1
         fut = self._submit(OP_GET, group, flags,
                            max(int(watermark), 0),
-                           query.encode("utf-8"))
+                           query.encode("utf-8"),
+                           deadline_s=timeout, deadline_ms=deadline_ms)
         status, leader, body = fut.wait_raw(timeout)
         if status == ST_OK:
             return body.decode("utf-8")
@@ -953,13 +1065,17 @@ class RingClient:
             raise NotLeaderError(group, leader)
         if status == ST_UNAVAILABLE:
             raise TimeoutError(text)
+        if status == ST_OVERLOADED:
+            # leader field = Retry-After in milliseconds.
+            raise Overloaded("ring", max(leader, 10) / 1000.0, text)
         raise ValueError(text)
 
     def member_change(self, group: int, op: str, peer: int) -> dict:
         from raftsql_tpu.runtime.db import NotLeaderError
         fut = self._submit(OP_MEMBER, group, 0, 0,
                            json.dumps({"group": group, "op": op,
-                                       "peer": peer}).encode())
+                                       "peer": peer}).encode(),
+                           deadline_s=10.0)
         status, leader, body = fut.wait_raw(10.0)
         if status == ST_OK:
             return json.loads(body.decode("utf-8"))
@@ -973,7 +1089,8 @@ class RingClient:
         from raftsql_tpu.runtime.db import NotLeaderError
         fut = self._submit(OP_XFER, group, 0, 0,
                            json.dumps({"group": group,
-                                       "target": target}).encode())
+                                       "target": target}).encode(),
+                           deadline_s=10.0)
         status, leader, body = fut.wait_raw(10.0)
         if status == ST_OK:
             return json.loads(body.decode("utf-8"))
@@ -988,14 +1105,16 @@ class RingClient:
         fut = self._submit(OP_RESHARD, 0, 0, 0,
                            json.dumps({"verb": verb, "src": src,
                                        "dst": dst,
-                                       "slots": slots}).encode())
+                                       "slots": slots}).encode(),
+                           deadline_s=10.0)
         status, _leader, body = fut.wait_raw(10.0)
         if status == ST_OK:
             return json.loads(body.decode("utf-8"))
         raise ValueError(body.decode("utf-8", "replace"))
 
     def _doc(self, name: str, timeout: float = 5.0) -> str:
-        fut = self._submit(OP_DOC, 0, 0, 0, name.encode())
+        fut = self._submit(OP_DOC, 0, 0, 0, name.encode(),
+                           deadline_s=timeout)
         status, _leader, body = fut.wait_raw(timeout)
         if status != ST_OK:
             raise RuntimeError(body.decode("utf-8", "replace"))
@@ -1066,6 +1185,11 @@ class RingFuture:
         text = body.decode("utf-8", "replace")
         if status == ST_NOT_LEADER:
             return RingNotLeader(leader, text)
+        if status == ST_OVERLOADED:
+            # leader field = Retry-After in milliseconds; the worker's
+            # HTTP plane maps this onto 429 + Retry-After.
+            from raftsql_tpu.overload import Overloaded
+            return Overloaded("ring", max(leader, 10) / 1000.0, text)
         return RuntimeError(text)
 
     def add_done_callback(self, cb) -> None:

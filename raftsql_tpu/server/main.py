@@ -539,6 +539,21 @@ def main(argv=None) -> None:
                          "replicas subscribe with `python -m "
                          "raftsql_tpu.replica --upstream host:PORT` "
                          "and serve the read ladder remotely; 0 = off")
+    ap.add_argument("--overload-cap", type=int, default=0,
+                    help="bounded admission: max queued-but-unstaged "
+                         "proposals per ENGINE (raftsql_tpu/overload/;"
+                         " excess answers 429 + Retry-After on every "
+                         "serving surface); 0 = no engine budget")
+    ap.add_argument("--overload-group-cap", type=int, default=0,
+                    help="bounded admission: max queued-but-unstaged "
+                         "proposals per GROUP; 0 = no group budget")
+    ap.add_argument("--brownout-hi", type=float, default=None,
+                    help="queue-depth EWMA above which linear reads "
+                         "degrade to lease-only (the brownout ladder; "
+                         "default 0.75 x --overload-cap)")
+    ap.add_argument("--brownout-lo", type=float, default=None,
+                    help="queue-depth EWMA below which the brownout "
+                         "ladder disengages (default brownout-hi / 3)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _pin_platform_from_env()
@@ -637,6 +652,22 @@ def main(argv=None) -> None:
                          election_quorum=args.election_quorum,
                          witnesses=tuple(args.witness))
     _watch_fatal(rdb)
+    if args.overload_cap or args.overload_group_cap \
+            or args.brownout_hi is not None:
+        if not (args.fused or args.mesh):
+            # The admission plane guards the co-located engine's
+            # propose queues; the pod and distributed deployments have
+            # no overload story yet — refuse loudly rather than boot a
+            # server whose knobs silently do nothing.
+            ap.error("--overload-cap/--overload-group-cap/--brownout-* "
+                     "require --fused or --mesh")
+        from raftsql_tpu.overload import OverloadController
+        rdb.pipe.node.overload = OverloadController(
+            args.groups, group_cap=args.overload_group_cap,
+            total_cap=args.overload_cap, seed=0,
+            tick_interval_s=args.tick,
+            brownout_hi=args.brownout_hi,
+            brownout_lo=args.brownout_lo)
     if args.placement:
         if not (args.fused or args.mesh):
             ap.error("--placement requires --fused or --mesh (the "

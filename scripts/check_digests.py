@@ -54,6 +54,13 @@ def _families(seed: int):
     # + invariant verdicts + fired fault families.
     yield "replica", _run_replica(S.generate_replica(seed)), \
         "plan_digest"
+    # Overload nemesis (raftsql_tpu/overload/): fully deterministic
+    # fused-plane tier — the committed history under bounded admission
+    # is bit-reproducible, so the pin covers both the seeded offered-
+    # load script and the admission/shed behaviour on the hot path.
+    from raftsql_tpu.chaos.run import _run_overload
+    yield "overload", _run_overload(S.generate_overload(seed)), \
+        "plan_digest"
 
 
 def main(argv=None) -> int:

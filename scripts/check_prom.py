@@ -219,6 +219,16 @@ def lint_url(host: str, port: int, label: str = "",
                        "raftsql_quorum_election_size",
                        "raftsql_quorum_witnesses",
                        "raftsql_witness_appends",
+                       # Overload plane (raftsql_tpu/overload/):
+                       # admission + shed + brownout counters, present
+                       # (0) even with admission disabled so
+                       # dashboards can rate() them unconditionally.
+                       "raftsql_overload_admitted",
+                       "raftsql_overload_rejected",
+                       "raftsql_overload_shed_edge",
+                       "raftsql_overload_shed_stage",
+                       "raftsql_overload_brownouts",
+                       "raftsql_overload_queue_depth",
                        ) + extra_required
     for required in required_series:
         assert any(n == required for (n, _l) in samples), \

@@ -1,0 +1,314 @@
+"""Saturation A/B: admission control on vs off at 2x offered load.
+
+The acceptance artifact for the overload plane (PR 20).  One process
+boots the fused runtime twice on identical data and drives the SAME
+seeded open-loop workload at ~2x the engine's measured closed-loop
+capacity:
+
+  arm "off"  — no controller attached (the pre-PR-20 behavior): every
+               offered PUT queues, the propose backlog grows without
+               bound for as long as the load lasts, and tail latency
+               is the whole backlog's drain time.
+  arm "on"   — OverloadController attached with a bounded budget:
+               offers beyond the budget are REFUSED up front
+               (Overloaded -> the HTTP planes' 429), the backlog never
+               exceeds the cap, and the latency of everything actually
+               admitted stays bounded by cap/drain-rate.
+
+A calibration phase measures closed-loop capacity first, so "2x load"
+means 2x THIS machine's observed rate, not a magic number.  The report
+lands in bench_logs/ with both arms' goodput, p50/p99 ack latency,
+queue peaks, and the controller's shed/brownout attribution.
+
+Deterministic by construction (raftlint determinism scope covers
+scripts/): load shape from --seed, pacing from monotonic clocks, no
+wall-clock timestamps in the report.
+
+Usage:  python scripts/overload_ab.py [--seed 0] [--out bench_logs/...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DRAIN_TIMEOUT_S = 60.0
+
+
+def _boot(tmp, groups):
+    from raftsql_tpu.config import RaftConfig
+    from raftsql_tpu.models.sqlite_sm import SQLiteStateMachine
+    from raftsql_tpu.runtime.db import RaftDB
+    from raftsql_tpu.runtime.fused import FusedClusterNode, FusedPipe
+
+    # tick_interval_s matches the loop pace so the step clock is
+    # TRUTHFUL: deadline_steps converts wall budgets at the real step
+    # cadence (an untimed cfg would convert at the 0.1 ms/step floor
+    # and stretch every deadline 5x).
+    cfg = RaftConfig(num_groups=groups, num_peers=3, log_window=64,
+                     max_entries_per_msg=4, tick_interval_s=0.0005)
+    node = FusedClusterNode(cfg, os.path.join(tmp, "data"))
+    node.start(interval_s=0.0005)
+    rdb = RaftDB(lambda g: SQLiteStateMachine(
+        os.path.join(tmp, f"g{g}.db")), pipe=FusedPipe(node),
+        num_groups=groups)
+    return node, rdb
+
+
+def _prep_tables(rdb, groups):
+    for g in range(groups):
+        err = rdb.propose("CREATE TABLE IF NOT EXISTS kv "
+                          "(k TEXT PRIMARY KEY, v TEXT)", g).wait(10.0)
+        if err is not None:
+            raise RuntimeError(f"create table group {g}: {err}")
+
+
+def _calibrate(node, rdb, groups, n=600):
+    """Open-loop capacity: n pipelined PUTs, clocked to the last ack
+    -> (puts/second, device-steps/second).  Open loop matters: a
+    serial closed loop pays a full commit round per put and
+    underestimates the engine's drain rate by an order of magnitude.
+    The step rate matters too: deadlines travel in DEVICE STEPS, and
+    a loaded loop ticks much slower than its idle interval — the wire
+    deadline must be denominated at the observed cadence."""
+    futs = []
+    s0 = node._device_steps
+    t0 = time.monotonic()
+    for i in range(n):
+        futs.append(rdb.propose("INSERT OR REPLACE INTO kv VALUES "
+                                f"('cal{i}','x')", i % groups))
+    for i, f in enumerate(futs):
+        err = f.wait(30.0)
+        if err is not None:
+            raise RuntimeError(f"calibration put {i}: {err}")
+    dt = max(time.monotonic() - t0, 1e-6)
+    return n / dt, max((node._device_steps - s0) / dt, 1.0)
+
+
+def _queue_depth(node):
+    with node._prop_lock:
+        return sum(len(q) for row in node._props for q in row)
+
+
+def _percentile(sorted_xs, p):
+    if not sorted_xs:
+        return None
+    k = min(int(len(sorted_xs) * p), len(sorted_xs) - 1)
+    return round(sorted_xs[k] * 1000.0, 2)      # milliseconds
+
+
+def _run_arm(name, seed, groups, rate, duration_s, deadline_ms,
+             caps):
+    """One arm: offered load at `rate` puts/s for `duration_s`.
+    `caps` is None (arm off) or (group_cap, total_cap)."""
+    from raftsql_tpu.overload import DeadlineExceeded, Overloaded
+
+    tmp = tempfile.mkdtemp(prefix=f"overload-ab-{name}-")
+    node, rdb = _boot(tmp, groups)
+    try:
+        _prep_tables(rdb, groups)
+        if caps is not None:
+            from raftsql_tpu.overload import OverloadController
+            node.overload = OverloadController(
+                groups, group_cap=caps[0], total_cap=caps[1],
+                seed=seed, tick_interval_s=0.0005)
+
+        rng = random.Random(seed)
+        lat = []                 # ack latencies (s), cb-thread appended
+        errs = [0]
+        offered = rejected = shed = 0
+        peak_depth = 0
+        round_dt = 0.01
+        batch = max(1, int(rate * round_dt))
+        rounds = max(1, int(duration_s / round_dt))
+        t_start = time.monotonic()
+        next_round = t_start
+        for _ in range(rounds):
+            for _ in range(batch):
+                offered += 1
+                g = rng.randrange(groups)
+                k = rng.randrange(4096)
+                dl = deadline_ms if rng.random() < 0.3 else None
+                t_sub = time.monotonic()
+
+                def _acked(err, t_sub=t_sub):
+                    if err is None:
+                        lat.append(time.monotonic() - t_sub)
+                    elif isinstance(err, DeadlineExceeded):
+                        pass     # attributed via controller counters
+                    else:
+                        errs[0] += 1
+                try:
+                    rdb.propose("INSERT OR REPLACE INTO kv VALUES "
+                                f"('k{k}','v')", g,
+                                deadline_ms=dl).add_done_callback(_acked)
+                except Overloaded:
+                    rejected += 1
+                except DeadlineExceeded:
+                    shed += 1
+            peak_depth = max(peak_depth, _queue_depth(node))
+            next_round += round_dt
+            pause = next_round - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+        offered_s = time.monotonic() - t_start
+
+        # Let the backlog drain (the off arm's is the whole phase).
+        t_drain = time.monotonic()
+        while _queue_depth(node) > 0:
+            if time.monotonic() - t_drain > DRAIN_TIMEOUT_S:
+                break
+            time.sleep(0.01)
+        time.sleep(0.2)          # let trailing ack callbacks land
+        total_s = time.monotonic() - t_start
+
+        ov = node.overload.metrics_doc() if node.overload is not None \
+            else None
+        acked = len(lat)
+        lat.sort()
+        return {
+            "arm": name,
+            "offered": offered,
+            "acked": acked,
+            "rejected_upfront": rejected,
+            "shed_upfront": shed,
+            "errors": errs[0],
+            "goodput_puts_per_s": round(acked / max(total_s, 1e-6), 1),
+            "offered_phase_s": round(offered_s, 3),
+            "total_s": round(total_s, 3),
+            "ack_p50_ms": _percentile(lat, 0.50),
+            "ack_p99_ms": _percentile(lat, 0.99),
+            "queue_depth_peak": peak_depth,
+            "overload": ov,
+        }
+    finally:
+        rdb.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--groups", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="offered-load phase seconds per arm")
+    ap.add_argument("--overload-factor", type=float, default=2.0,
+                    help="offered rate as a multiple of measured "
+                         "closed-loop capacity")
+    ap.add_argument("--out", default=None,
+                    help="report path (default bench_logs/"
+                         "overload_ab_s<seed>.json)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # Calibrate on a throwaway boot so neither arm starts warm.
+    tmp = tempfile.mkdtemp(prefix="overload-ab-cal-")
+    node, rdb = _boot(tmp, args.groups)
+    try:
+        _prep_tables(rdb, args.groups)
+        cap_rate, step_rate = _calibrate(node, rdb, args.groups)
+    finally:
+        rdb.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    rate = cap_rate * args.overload_factor
+    # Budget: ~a quarter second of drain at capacity, so refusals are
+    # certain at 2x offered while the admitted backlog stays cheap.
+    total_cap = max(32, int(cap_rate * 0.25))
+    group_cap = max(8, total_cap // args.groups * 2)
+    # Deadline budget: half the FULL queue's drain time IN WALL TERMS.
+    # The wire value is milliseconds, but the engine converts it to
+    # device steps at cfg.tick_interval_s — and a loaded loop ticks at
+    # its own cadence, not the configured interval.  Denominate the
+    # wire number so the STEP deadline corresponds to the intended
+    # wall budget at the measured step rate.
+    wall_deadline_s = 0.5 * total_cap / cap_rate
+    deadline_ms = max(1.0, wall_deadline_s * step_rate * 0.0005
+                      * 1000.0)
+
+    print(f"overload-ab: seed={args.seed} capacity={cap_rate:.0f}/s "
+          f"steps={step_rate:.0f}/s offered={rate:.0f}/s "
+          f"x{args.duration:.0f}s caps=({group_cap},{total_cap}) "
+          f"deadline={deadline_ms:.0f}ms-wire "
+          f"(~{wall_deadline_s * 1000:.0f}ms wall)", flush=True)
+
+    arms = {}
+    for name, caps in (("off", None), ("on", (group_cap, total_cap))):
+        arms[name] = _run_arm(name, args.seed, args.groups, rate,
+                              args.duration, deadline_ms, caps)
+        a = arms[name]
+        print(f"  {name:>3}: acked={a['acked']}/{a['offered']} "
+              f"rejected={a['rejected_upfront']} "
+              f"p99={a['ack_p99_ms']}ms "
+              f"goodput={a['goodput_puts_per_s']}/s "
+              f"queue_peak={a['queue_depth_peak']}", flush=True)
+
+    on, off = arms["on"], arms["off"]
+    verdicts = {
+        # The tentpole claim: the budget is a hard memory bound.
+        "bounded_on": on["queue_depth_peak"] <= total_cap,
+        # 2x load genuinely oversubscribes: the uncontrolled arm's
+        # backlog blows past the budget the controlled arm enforces.
+        "unbounded_off": off["queue_depth_peak"] > total_cap,
+        # Refusals happened (the load was actually shed, not absorbed).
+        "refusals_on": on["rejected_upfront"] > 0
+        or (on["overload"] or {}).get("rejected", 0) > 0,
+        # Goodput floor: admission refuses EXCESS load, it must not
+        # collapse the throughput of what it admits.
+        "goodput_floor": on["goodput_puts_per_s"]
+        >= 0.5 * off["goodput_puts_per_s"],
+        # Bounded tail: the admitted backlog is capped, so p99 should
+        # beat the unbounded arm's drain-the-world tail.
+        "p99_improved": (on["ack_p99_ms"] or 0) < (off["ack_p99_ms"]
+                                                   or float("inf")),
+        # Deadline attribution: the budget is half the full queue's
+        # drain time, so deadline-carrying PUTs admitted behind a full
+        # queue MUST shed at staging (before WAL cost) — the per-phase
+        # counters prove the shed path runs, not just the refusal one.
+        "deadline_sheds_on": (on["overload"] or {}).get(
+            "shed_stage", 0) > 0,
+    }
+    report = {
+        "bench": "overload_admission_ab",
+        "seed": args.seed, "groups": args.groups,
+        "capacity_puts_per_s": round(cap_rate, 1),
+        "device_steps_per_s": round(step_rate, 1),
+        "overload_factor": args.overload_factor,
+        "offered_puts_per_s": round(rate, 1),
+        "duration_s": args.duration,
+        "group_cap": group_cap, "total_cap": total_cap,
+        "deadline_ms_wire": round(deadline_ms, 1),
+        "deadline_ms_wall": round(wall_deadline_s * 1000.0, 1),
+        "arms": arms, "verdicts": verdicts,
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_logs", f"overload_ab_s{args.seed}.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"overload-ab: report -> {out}", flush=True)
+    for k, v in verdicts.items():
+        print(f"  verdict {k}: {'ok' if v else 'FAIL'}", flush=True)
+
+    hard = ("bounded_on", "unbounded_off", "refusals_on",
+            "goodput_floor", "deadline_sheds_on")
+    if not all(verdicts[k] for k in hard):
+        print("overload-ab: FAILED hard verdicts", flush=True)
+        return 1
+    if not verdicts["p99_improved"]:
+        print("overload-ab: WARNING: p99 did not improve", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ import sys
 
 import pytest
 
-from raftsql_tpu.runtime.ring import (OP_PUT, ST_ERR, RingClient,
+from raftsql_tpu.runtime.ring import (OP_GET, OP_PUT, ST_ERR, RingClient,
                                       RingServer, SpscRing,
                                       decode_completion, decode_request,
                                       encode_completion, encode_request)
@@ -109,7 +109,12 @@ def test_ring_attach_sees_producer(tmp_path):
 def test_request_completion_codecs():
     rec = encode_request(OP_PUT, 42, 7, 1, 0xDEADBEEF, b"INSERT x")
     assert decode_request(memoryview(rec)) == (OP_PUT, 42, 7, 1,
-                                               0xDEADBEEF, b"INSERT x")
+                                               0xDEADBEEF, 0,
+                                               b"INSERT x")
+    rec = encode_request(OP_GET, 43, 0, 1, 0, b"SELECT 1",
+                         deadline_mono_ms=123456)
+    assert decode_request(memoryview(rec)) == (OP_GET, 43, 0, 1, 0,
+                                               123456, b"SELECT 1")
     cpl = encode_completion(42, ST_ERR, 3, b"boom")
     assert decode_completion(memoryview(cpl)) == (42, ST_ERR, 3, b"boom")
 
